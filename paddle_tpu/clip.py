@@ -1,0 +1,123 @@
+"""Gradient / error clipping (reference: fluid/clip.py — ErrorClipByValue,
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm)."""
+
+from .core import unique_name
+from .core.program import Variable
+
+
+def _tmp_like(block, ref, tag):
+    v = Variable(
+        block, name=unique_name.generate(f"{ref.name}.{tag}"),
+        shape=ref.shape, dtype=ref.dtype, stop_gradient=True,
+    )
+    block.vars[v.name] = v
+    return v
+
+
+class BaseGradientClipAttr:
+    def _append_clip_op(self, block, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _append_clip_op(self, block, grad):
+        return grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _append_clip_op(self, block, grad):
+        block.append_op(
+            type="clip", inputs={"X": [grad.name]}, outputs={"Out": [grad.name]},
+            attrs={"min": float(self.min), "max": float(self.max)},
+        )
+        return grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _append_clip_op(self, block, grad):
+        block.append_op(
+            type="clip_by_norm", inputs={"X": [grad.name]},
+            outputs={"Out": [grad.name]}, attrs={"max_norm": float(self.clip_norm)},
+        )
+        return grad
+
+
+class GradientClipByGlobalNorm:
+    """Global-norm clipping across a parameter group; applied in one pass by
+    append_gradient_clip_ops (needs all grads together)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+
+def error_clip_callback(block, var, max=None, min=None):
+    """ErrorClipByValue analog: clip an activation's gradient.  With jax.grad
+    there are no intermediate grad vars to clip, so error clip applies to
+    the variable's *forward* value contribution via clip op on the var."""
+    block.append_op(
+        type="clip", inputs={"X": [var.name]}, outputs={"Out": [var.name]},
+        attrs={"min": float(min if min is not None else -max), "max": float(max)},
+    )
+
+
+def append_gradient_clip_ops(param_grads, global_clip=None):
+    """Apply per-param gradient_clip_attr, or a GradientClipByGlobalNorm over
+    the whole list."""
+    if isinstance(global_clip, GradientClipByGlobalNorm):
+        block = param_grads[0][0].block
+        # global_norm = sqrt(sum over params of sum(g^2))
+        sq_norms = []
+        for p, g in param_grads:
+            sq = _tmp_like(block, g, "sq")
+            block.append_op(
+                type="squared_l2_norm", inputs={"X": [g.name]},
+                outputs={"Out": [sq.name]},
+            )
+            sq.shape = (1,)
+            sq_norms.append(sq)
+        total = _tmp_like(block, sq_norms[0], "global_sq")
+        total.shape = (1,)
+        block.append_op(
+            type="sum", inputs={"X": [v.name for v in sq_norms]},
+            outputs={"Out": [total.name]},
+        )
+        gnorm = _tmp_like(block, total, "global_norm")
+        block.append_op(type="sqrt", inputs={"X": [total.name]}, outputs={"Out": [gnorm.name]})
+        # factor = clip_norm / max(global_norm, clip_norm)
+        cn = _tmp_like(block, gnorm, "clip_norm")
+        block.append_op(
+            type="fill_constant", outputs={"Out": [cn.name]},
+            attrs={"shape": [1], "dtype": gnorm.dtype.name,
+                   "value": float(global_clip.clip_norm)},
+        )
+        maxed = _tmp_like(block, gnorm, "maxed")
+        block.append_op(
+            type="elementwise_max", inputs={"X": [gnorm.name], "Y": [cn.name]},
+            outputs={"Out": [maxed.name]},
+        )
+        factor = _tmp_like(block, gnorm, "factor")
+        block.append_op(
+            type="elementwise_div", inputs={"X": [cn.name], "Y": [maxed.name]},
+            outputs={"Out": [factor.name]},
+        )
+        for p, g in param_grads:
+            block.append_op(
+                type="elementwise_mul", inputs={"X": [g.name], "Y": [factor.name]},
+                outputs={"Out": [g.name]}, attrs={"axis": 0},
+            )
+        return param_grads
+
+    result = []
+    for p, g in param_grads:
+        clip_attr = getattr(p, "gradient_clip_attr", None)
+        if clip_attr is not None:
+            g = clip_attr._append_clip_op(p.block, g)
+        result.append((p, g))
+    return result

@@ -1,0 +1,20 @@
+from .dtypes import convert_dtype
+from .place import CPUPlace, TPUPlace, Place, is_compiled_with_tpu
+from . import unique_name
+from .program import (
+    Variable,
+    Parameter,
+    OpDesc,
+    Block,
+    Program,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    switch_main_program,
+    switch_startup_program,
+    name_scope,
+)
+from .registry import OpImpl, register_op, get_op_impl, registered_ops
+from .scope import Scope, global_scope, scope_guard
+from .executor import Executor
+from . import ir

@@ -1,0 +1,47 @@
+"""Dtype handling.
+
+The reference enumerates dtypes in ``paddle/framework/framework.proto:91``
+(VarType.Type: BOOL..FP64) and converts at kernel-dispatch time
+(``paddle/framework/data_type_transform.cc``).  Here dtypes are plain numpy /
+jax dtypes; bfloat16 is first-class because it is the MXU-native type.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+_DTYPE_MAP = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    # "int64" maps to int32: TPU has no native int64 and JAX truncates it
+    # without x64 mode anyway.  The reference uses int64 for ids/labels
+    # (VarType.INT64); int32 covers every vocab/label size it supports.
+    "int64": jnp.int32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    # reference spelling (VarType enum names, framework.proto:91)
+    "fp16": jnp.float16,
+    "fp32": jnp.float32,
+    "fp64": jnp.float64,
+}
+
+
+def convert_dtype(dtype):
+    """Accept a string / numpy dtype / jax dtype; return a canonical numpy dtype."""
+    if dtype is None:
+        return np.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_MAP:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return np.dtype(_DTYPE_MAP[dtype])
+    return np.dtype(dtype)
+
+
+def is_floating(dtype):
+    return np.issubdtype(convert_dtype(dtype), np.floating) or convert_dtype(
+        dtype
+    ) == np.dtype(jnp.bfloat16)

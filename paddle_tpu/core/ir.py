@@ -1,0 +1,56 @@
+"""Program transformations: pruning / inference conversion.
+
+Reference: ``paddle/framework/prune.{h,cc}`` + ``pybind.cc:289 m.def("prune")``
+and ``inference_optimize`` (pybind.cc:299).  Used by save_inference_model to
+slice a training program down to the feed->fetch subgraph.
+"""
+
+import copy
+
+
+def prune_program(program, targets):
+    """Return a deep-copied program whose global block keeps only ops needed
+    (transitively) to compute ``targets`` (Variables or names)."""
+    target_names = {t.name if hasattr(t, "name") else str(t) for t in targets}
+    pruned = copy.deepcopy(program)
+    block = pruned.global_block()
+
+    def sub_block_reads(block_idx, seen=None):
+        """All names read anywhere under a sub-block, recursing into nested
+        control-flow ops (while containing scan_block, etc.)."""
+        seen = seen if seen is not None else set()
+        if block_idx in seen:
+            return set()
+        seen.add(block_idx)
+        reads = set()
+        for sop in pruned.block(block_idx).ops:
+            reads |= set(sop.input_names())
+            nested = sop.attrs.get("sub_block")
+            if nested is not None:
+                reads |= sub_block_reads(nested, seen)
+        return reads
+
+    needed = set(target_names)
+    kept = []
+    for op in reversed(block.ops):
+        produced = set(op.output_names())
+        if produced & needed:
+            kept.append(op)
+            needed |= set(op.input_names())
+            # control-flow ops pull in their (possibly nested) sub-block reads
+            sub_idx = op.attrs.get("sub_block")
+            if sub_idx is not None:
+                needed |= sub_block_reads(sub_idx)
+    kept.reverse()
+    block.ops = kept
+    block.backward_index = None
+    pruned._backward_info = {}
+
+    referenced = set(needed) | target_names
+    for blk in pruned.blocks:
+        for op in blk.ops:
+            referenced |= set(op.input_names()) | set(op.output_names())
+    block.vars = type(block.vars)(
+        (n, v) for n, v in block.vars.items() if n in referenced
+    )
+    return pruned

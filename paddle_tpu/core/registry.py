@@ -1,0 +1,77 @@
+"""Op registry.
+
+Reference: ``paddle/framework/op_registry.h:148 REGISTER_OP`` plus a kernel
+map keyed by (dtype, place, layout, library) — ``operator.h:368``.  On TPU
+there is exactly one "kernel" per op: a pure JAX function.  XLA handles dtype
+specialization, layout and fusion, so the whole OpKernelType dispatch /
+data-transform machinery (operator.cc:460-536) disappears by design.
+
+Implementations are plain functions whose parameters are the op's input slot
+names (capitalized, fluid convention: X, Y, Input, Filter, ...) plus attrs as
+keyword arguments; they return ``{slot: array-or-list}``:
+
+    @register_op("elementwise_add")
+    def elementwise_add(X, Y, axis=-1, **_):
+        return {"Out": X + Y}
+
+Control-flow / meta ops register with ``raw=True`` and receive the lowering
+context instead (they splice sub-blocks into lax.scan / while_loop / cond).
+"""
+
+import inspect
+
+_REGISTRY = {}
+
+
+class OpImpl:
+    def __init__(self, op_type, fn, raw=False, stateful_rng=False,
+                 nondiff=False):
+        self.type = op_type
+        self.fn = fn
+        self.raw = raw
+        self.stateful_rng = stateful_rng
+        # nondiff: op has no linearization rule (integer outputs, argsort-
+        # style selection, DP recursions over ints).  The executor
+        # stop_gradients its inputs inside the grad prefix so linearization
+        # treats it as a constant computation.
+        self.nondiff = nondiff
+        if not raw:
+            sig = inspect.signature(fn)
+            self.params = set(sig.parameters)
+            self.has_var_kw = any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.parameters.values()
+            )
+            self.wants_ctx = "_ctx" in self.params
+
+    def call(self, ins, attrs, ctx):
+        kwargs = dict(ins)
+        for k, v in attrs.items():
+            if self.has_var_kw or k in self.params:
+                kwargs[k] = v
+        if self.wants_ctx:
+            kwargs["_ctx"] = ctx
+        return self.fn(**kwargs)
+
+
+def register_op(op_type, raw=False, stateful_rng=False, nondiff=False):
+    def deco(fn):
+        if op_type in _REGISTRY:
+            raise ValueError(f"op {op_type!r} registered twice")
+        _REGISTRY[op_type] = OpImpl(
+            op_type, fn, raw=raw, stateful_rng=stateful_rng, nondiff=nondiff
+        )
+        return fn
+
+    return deco
+
+
+def get_op_impl(op_type):
+    impl = _REGISTRY.get(op_type)
+    if impl is None:
+        raise KeyError(f"no implementation registered for op {op_type!r}")
+    return impl
+
+
+def registered_ops():
+    return sorted(_REGISTRY)

@@ -1,0 +1,75 @@
+"""Scope — name -> device array environment.
+
+Reference: ``paddle/framework/scope.h`` (Scope = name->Variable map with a
+parent chain; executor creates a local scope per run).  Here a Scope holds
+the *persistable* state between Executor runs: parameters, optimizer moments,
+batch-norm stats, metric accumulators and the RNG key — all jax.Arrays living
+on device.  Non-persistable intermediates never materialize: they are fused
+away inside the jitted step.
+"""
+
+import contextlib
+
+import jax
+import numpy as np
+
+RNG_VAR = "@RNG@"
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def var_names(self):
+        return list(self._vars)
+
+    def get(self, name):
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in scope")
+        return v
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def update(self, mapping):
+        self._vars.update(mapping)
+
+    def delete(self, name):
+        self._vars.pop(name, None)
+
+    def numpy(self, name):
+        return np.asarray(self.get(name))
+
+    def new_scope(self):
+        return Scope(parent=self)
+
+    def ensure_rng(self, seed=0):
+        if self.find_var(RNG_VAR) is None:
+            self.set(RNG_VAR, jax.random.PRNGKey(seed))
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield scope
+    finally:
+        _scope_stack.pop()

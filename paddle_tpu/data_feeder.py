@@ -1,0 +1,58 @@
+"""DataFeeder — convert Python minibatches to feed dicts.
+
+Reference: fluid/data_feeder.py (numpy → LoDTensor with LoD set from ragged
+lists).  TPU version: ragged rows pad to a bucketed max length (rounded up
+to a multiple of ``pad_multiple`` so XLA sees few distinct shapes and the
+compile cache stays small) and fill the shadow ``<name>@LENGTH`` variable —
+same information as LoD, static shapes.
+"""
+
+import numpy as np
+
+from .core.program import LENGTH_SUFFIX
+
+
+def _round_up(n, m):
+    return ((n + m - 1) // m) * m
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, pad_multiple=8):
+        self.feed_vars = feed_list
+        self.place = place
+        self.pad_multiple = pad_multiple
+
+    def feed(self, data):
+        """data: iterable of rows, each row a tuple with one entry per feed
+        var.  Returns {name: ndarray} including @LENGTH entries for
+        lod_level>0 vars."""
+        rows = list(data)
+        result = {}
+        for i, var in enumerate(self.feed_vars):
+            col = [row[i] for row in rows]
+            if getattr(var, "lod_level", 0) > 0:
+                arrs = [np.asarray(c, dtype=var.dtype) for c in col]
+                lens = np.asarray([a.shape[0] for a in arrs], dtype=np.int32)
+                max_len = max(1, _round_up(int(lens.max()), self.pad_multiple))
+                feat = arrs[0].shape[1:]
+                # honor a declared static time dim if the var has one
+                declared = var.shape[1] if len(var.shape) > 1 else -1
+                if declared and declared > 0:
+                    max_len = declared
+                out = np.zeros((len(arrs), max_len) + feat, dtype=var.dtype)
+                for j, a in enumerate(arrs):
+                    t = min(a.shape[0], max_len)
+                    out[j, :t] = a[:t]
+                result[var.name] = out
+                result[var.name + LENGTH_SUFFIX] = np.minimum(lens, max_len)
+            else:
+                arr = np.asarray(col, dtype=var.dtype)
+                want = [s for s in var.shape]
+                if (
+                    len(want) >= 2
+                    and arr.ndim == len(want) - 1
+                    and want[-1] == 1
+                ):
+                    arr = arr[..., None]  # fluid's trailing [.,1] label shape
+                result[var.name] = arr
+        return result

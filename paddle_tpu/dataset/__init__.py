@@ -1,0 +1,25 @@
+"""Dataset loaders (reference: python/paddle/v2/dataset — mnist, cifar,
+imdb, uci_housing, wmt14/16, movielens, conll05, sentiment, flowers,
+voc2012, with download cache in common.py).
+
+This environment has no network egress, so each loader first looks for the
+reference's cache layout under ``~/.cache/paddle_tpu/dataset`` and otherwise
+serves a deterministic synthetic surrogate with the *exact* sample schema of
+the real dataset (same shapes/dtypes/vocab conventions) so every model and
+test runs unchanged; plug real data in by populating the cache directory.
+"""
+
+from . import common
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import movielens
+from . import wmt14
+from . import wmt16
+from . import conll05
+
+__all__ = [
+    "common", "mnist", "cifar", "uci_housing", "imdb", "movielens",
+    "wmt14", "wmt16", "conll05",
+]

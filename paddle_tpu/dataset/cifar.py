@@ -1,0 +1,62 @@
+"""CIFAR-10/100 (reference: v2/dataset/cifar.py).  Schema: (3072 float32
+image flattened CHW in [0,1], int64 label)."""
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+_SYN_TRAIN = 4096
+_SYN_TEST = 512
+
+
+def _real_reader(tar_path, sub_name):
+    def reader():
+        with tarfile.open(tar_path, mode="r") as f:
+            names = [n for n in f.getnames() if sub_name in n]
+            for name in names:
+                batch = pickle.load(f.extractfile(name), encoding="bytes")
+                data = batch[b"data"]
+                labels = batch.get(b"labels") or batch.get(b"fine_labels")
+                for s, l in zip(data, labels):
+                    yield (s / 255.0).astype(np.float32), int(l)
+
+    return reader
+
+
+def _synthetic_reader(n, num_classes, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        protos = rng.uniform(0, 1, size=(num_classes, 3072)).astype(np.float32)
+        for _ in range(n):
+            label = int(rng.randint(0, num_classes))
+            img = protos[label] + 0.15 * rng.randn(3072).astype(np.float32)
+            yield np.clip(img, 0, 1).astype(np.float32), label
+
+    return reader
+
+
+def _make(which, sub, n, classes, seed):
+    path = common.data_path("cifar", which)
+    if os.path.exists(path):
+        return _real_reader(path, sub)
+    return _synthetic_reader(n, classes, seed)
+
+
+def train10():
+    return _make("cifar-10-python.tar.gz", "data_batch", _SYN_TRAIN, 10, 1)
+
+
+def test10():
+    return _make("cifar-10-python.tar.gz", "test_batch", _SYN_TEST, 10, 2)
+
+
+def train100():
+    return _make("cifar-100-python.tar.gz", "train", _SYN_TRAIN, 100, 3)
+
+
+def test100():
+    return _make("cifar-100-python.tar.gz", "test", _SYN_TEST, 100, 4)

@@ -1,0 +1,79 @@
+"""Dataset cache helpers (reference: v2/dataset/common.py — DATA_HOME,
+download with md5, converter to RecordIO)."""
+
+import hashlib
+import os
+import pickle
+import struct
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def data_path(module, filename):
+    d = os.path.join(DATA_HOME, module)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, filename)
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum=None):
+    """No-egress environment: succeed only if the file is already cached."""
+    filename = data_path(module_name, url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            raise IOError(f"md5 mismatch for cached {filename}")
+        return filename
+    raise IOError(
+        f"cannot download {url} (no network egress); place the file at "
+        f"{filename} to use real data"
+    )
+
+
+# -- simple length-prefixed record file (RecordIO stand-in) -----------------
+def write_records(path, records):
+    with open(path, "wb") as f:
+        for rec in records:
+            f.write(struct.pack("<Q", len(rec)))
+            f.write(rec)
+
+
+def read_records(path):
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                return
+            (n,) = struct.unpack("<Q", hdr)
+            yield f.read(n)
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Serialize a reader's samples into chunked record files (reference
+    common.py convert → RecordIO chunks consumed by the Go master)."""
+    idx = 0
+    chunk = []
+    paths = []
+
+    def flush():
+        nonlocal idx, chunk
+        if not chunk:
+            return
+        p = os.path.join(output_path, f"{name_prefix}-{idx:05d}")
+        write_records(p, [pickle.dumps(s) for s in chunk])
+        paths.append(p)
+        idx += 1
+        chunk = []
+
+    for sample in reader():
+        chunk.append(sample)
+        if len(chunk) >= line_count:
+            flush()
+    flush()
+    return paths

@@ -1,0 +1,43 @@
+"""CoNLL-2005 SRL (reference: v2/dataset/conll05.py).  Schema: 8 parallel
+sequences (word, ctx_n2..ctx_p2, verb, mark) + IOB label sequence."""
+
+import numpy as np
+
+WORD_VOCAB = 44068
+PRED_VOCAB = 3162
+LABEL_COUNT = 67  # number of IOB SRL labels (reference label_dict size)
+MARK_VOCAB = 2
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(5, 40))
+            word = rng.randint(0, WORD_VOCAB, length).astype(np.int64).tolist()
+            ctx = [
+                rng.randint(0, WORD_VOCAB, length).astype(np.int64).tolist()
+                for _ in range(5)
+            ]
+            pred_id = int(rng.randint(0, PRED_VOCAB))
+            verb = [pred_id] * length
+            mark = rng.randint(0, MARK_VOCAB, length).astype(np.int64).tolist()
+            label = rng.randint(0, LABEL_COUNT, length).astype(np.int64).tolist()
+            yield (word, ctx[0], ctx[1], ctx[2], ctx[3], ctx[4], verb, mark, label)
+
+    return reader
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(PRED_VOCAB)}
+    label_dict = {f"l{i}": i for i in range(LABEL_COUNT)}
+    return word_dict, verb_dict, label_dict
+
+
+def test():
+    return _synthetic(256, 52)
+
+
+def train():
+    return _synthetic(2048, 51)

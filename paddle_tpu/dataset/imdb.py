@@ -1,0 +1,33 @@
+"""IMDB sentiment (reference: v2/dataset/imdb.py).  Schema: (list of int64
+word ids, int64 label in {0,1}).  Synthetic surrogate: two word
+distributions, one per class."""
+
+import numpy as np
+
+_VOCAB = 5148  # small word_dict size like the reference's cutoff builds
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        half = _VOCAB // 2
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 120))
+            base = 0 if label == 0 else half
+            ids = rng.randint(base, base + half, size=length).astype(np.int64)
+            yield ids.tolist(), label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _synthetic(2048, 11)
+
+
+def test(word_idx=None):
+    return _synthetic(256, 12)

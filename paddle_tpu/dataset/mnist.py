@@ -1,0 +1,67 @@
+"""MNIST (reference: v2/dataset/mnist.py).  Real data if the idx-format
+files are cached; otherwise a deterministic synthetic surrogate with the
+same schema: (784 float32 image in [-1, 1], int64 label 0-9)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+TRAIN_IMAGE = "train-images-idx3-ubyte.gz"
+TRAIN_LABEL = "train-labels-idx1-ubyte.gz"
+TEST_IMAGE = "t10k-images-idx3-ubyte.gz"
+TEST_LABEL = "t10k-labels-idx1-ubyte.gz"
+
+_SYN_TRAIN = 8192
+_SYN_TEST = 1024
+
+
+def _real_reader(image_file, label_file):
+    def reader():
+        with gzip.open(image_file, "rb") as fi, gzip.open(label_file, "rb") as fl:
+            fi.read(16)
+            fl.read(8)
+            while True:
+                lbl = fl.read(1)
+                img = fi.read(784)
+                if not lbl or len(img) < 784:
+                    break
+                image = (
+                    np.frombuffer(img, np.uint8).astype(np.float32) / 255.0
+                ) * 2.0 - 1.0
+                yield image, int(lbl[0])
+
+    return reader
+
+
+def _synthetic_reader(n, seed):
+    """Class-conditional gaussian blobs: learnable by LeNet, deterministic."""
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        protos = rng.uniform(-1, 1, size=(10, 784)).astype(np.float32)
+        for i in range(n):
+            label = int(rng.randint(0, 10))
+            img = protos[label] + 0.3 * rng.randn(784).astype(np.float32)
+            yield np.clip(img, -1, 1).astype(np.float32), label
+
+    return reader
+
+
+def _reader(image_name, label_name, n_syn, seed):
+    img = common.data_path("mnist", image_name)
+    lbl = common.data_path("mnist", label_name)
+    if os.path.exists(img) and os.path.exists(lbl):
+        return _real_reader(img, lbl)
+    return _synthetic_reader(n_syn, seed)
+
+
+def train():
+    return _reader(TRAIN_IMAGE, TRAIN_LABEL, _SYN_TRAIN, seed=90051)
+
+
+def test():
+    return _reader(TEST_IMAGE, TEST_LABEL, _SYN_TEST, seed=90052)

@@ -1,0 +1,33 @@
+"""WMT14 fr→en (reference: v2/dataset/wmt14.py).  Schema: (src_ids,
+trg_ids_with_<s>, trg_ids_next_with_<e>).  Dict size capped at 30k with
+<s>=0, <e>=1, <unk>=2.  Synthetic surrogate: reversal task (target = source
+reversed) so seq2seq models actually learn structure."""
+
+import numpy as np
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+
+_DICT_SIZE = 30000
+
+
+def _synthetic(n, dict_size, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        hi = min(dict_size, 1000)
+        for _ in range(n):
+            length = int(rng.randint(3, 12))
+            src = rng.randint(3, hi, size=length).astype(np.int64).tolist()
+            trg = list(reversed(src))
+            yield src, [0] + trg, trg + [1]
+
+    return reader
+
+
+def train(dict_size=_DICT_SIZE):
+    return _synthetic(2048, dict_size, 31)
+
+
+def test(dict_size=_DICT_SIZE):
+    return _synthetic(256, dict_size, 32)

@@ -1,0 +1,12 @@
+"""WMT16 (reference: v2/dataset/wmt16.py) — same schema as wmt14 with
+configurable src/trg dict sizes."""
+
+from . import wmt14
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return wmt14._synthetic(2048, min(src_dict_size, trg_dict_size), 41)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return wmt14._synthetic(256, min(src_dict_size, trg_dict_size), 42)

@@ -1,0 +1,225 @@
+"""Elastic dataset task dispatcher — the go/master rebuild.
+
+Reference: go/master/service.go — partition record chunks into tasks (:106),
+per-pass todo/pending/done queues, GetTask (:368), TaskFinished (:411),
+TaskFailed (:455) with failureMax poison-drop (:313), timeout watcher
+re-queueing (:341), queue snapshot to etcd (:207) / recover (:166); client
+NextRecord (go/master/client.go:244); C API consumed by
+v2/reader/creator.py:91 cloud_reader.
+
+Same design: trainers are stateless record consumers; any trainer death just
+re-queues its leased tasks after the timeout, giving elastic fault tolerance
+without checkpointing trainer state."""
+
+import pickle
+import threading
+import time
+import uuid
+
+from . import rpc
+from .store import InMemStore
+from ..dataset.common import read_records
+
+SNAPSHOT_KEY = "master/taskqueues"
+
+
+class Task:
+    def __init__(self, task_id, paths):
+        self.id = task_id
+        self.paths = list(paths)
+        self.failures = 0
+        self.deadline = None
+
+    def to_dict(self):
+        return {"id": self.id, "paths": self.paths, "failures": self.failures}
+
+    @staticmethod
+    def from_dict(d):
+        t = Task(d["id"], d["paths"])
+        t.failures = d["failures"]
+        return t
+
+
+class MasterService:
+    def __init__(self, store=None, chunks_per_task=1, timeout_sec=20,
+                 failure_max=3):
+        self.store = store or InMemStore()
+        self.chunks_per_task = chunks_per_task
+        self.timeout_sec = timeout_sec
+        self.failure_max = failure_max
+        self._lock = threading.Lock()
+        self.todo, self.pending, self.done, self.failed = [], {}, [], []
+        self._pass_id = 0
+        self._dataset_set = False
+        self._recover()
+        self._watcher = threading.Thread(target=self._check_timeouts, daemon=True)
+        self._watcher.start()
+
+    # -- persistence (service.go snapshot:207 / recover:166) ---------------
+    def _snapshot(self):
+        state = {
+            "todo": [t.to_dict() for t in self.todo],
+            "pending": [t.to_dict() for t in self.pending.values()],
+            "done": [t.to_dict() for t in self.done],
+            "failed": [t.to_dict() for t in self.failed],
+            "pass_id": self._pass_id,
+        }
+        self.store.put(SNAPSHOT_KEY, state)
+
+    def _recover(self):
+        state = self.store.get(SNAPSHOT_KEY)
+        if not state:
+            return
+        # pending tasks from a dead master go back to todo
+        self.todo = [Task.from_dict(d) for d in state["todo"]] + [
+            Task.from_dict(d) for d in state["pending"]
+        ]
+        self.done = [Task.from_dict(d) for d in state["done"]]
+        self.failed = [Task.from_dict(d) for d in state["failed"]]
+        self._pass_id = state["pass_id"]
+        self._dataset_set = bool(self.todo or self.done or self.failed)
+
+    # -- RPC surface -------------------------------------------------------
+    def set_dataset(self, chunk_paths):
+        """Partition chunk files into tasks (service.go partition:106).
+        First caller wins; later calls are no-ops (matching the reference)."""
+        with self._lock:
+            if self._dataset_set:
+                return self._pass_id
+            paths = sorted(chunk_paths)
+            for i in range(0, len(paths), self.chunks_per_task):
+                self.todo.append(
+                    Task(str(uuid.uuid4()), paths[i : i + self.chunks_per_task])
+                )
+            self._dataset_set = True
+            self._snapshot()
+            return self._pass_id
+
+    def get_task(self):
+        with self._lock:
+            if not self.todo:
+                if not self.pending and (self.done or self.failed):
+                    # pass finished: start next pass (per-pass queues,
+                    # service.go GetTask pass rollover)
+                    self.todo = self.done + self.failed
+                    self.done, self.failed = [], []
+                    self._pass_id += 1
+                if not self.todo:
+                    return None  # caller retries while pending drains
+            task = self.todo.pop(0)
+            task.deadline = time.time() + self.timeout_sec
+            self.pending[task.id] = task
+            self._snapshot()
+            return {"id": task.id, "paths": task.paths, "pass_id": self._pass_id}
+
+    def task_finished(self, task_id):
+        with self._lock:
+            task = self.pending.pop(task_id, None)
+            if task is None:
+                return False
+            task.failures = 0
+            self.done.append(task)
+            self._snapshot()
+            return True
+
+    def task_failed(self, task_id):
+        with self._lock:
+            task = self.pending.pop(task_id, None)
+            if task is None:
+                return False
+            self._process_failed(task)
+            self._snapshot()
+            return True
+
+    def _process_failed(self, task):
+        # processFailedTask (service.go:313): drop poison tasks
+        task.failures += 1
+        if task.failures >= self.failure_max:
+            self.failed.append(task)
+        else:
+            self.todo.append(task)
+
+    def _check_timeouts(self):
+        # checkTimeoutFunc (service.go:341)
+        while True:
+            time.sleep(self.timeout_sec / 4)
+            with self._lock:
+                now = time.time()
+                expired = [
+                    t for t in self.pending.values() if t.deadline and t.deadline < now
+                ]
+                for t in expired:
+                    del self.pending[t.id]
+                    self._process_failed(t)
+                if expired:
+                    self._snapshot()
+
+    # -- exactly-one-saver election (service.go:481 RequestSaveModel) ------
+    def request_save_model(self, trainer_id, block_sec=60):
+        key = "master/save_model_lock"
+        now = time.time()
+        holder = self.store.get(key)
+        if holder and holder["expires"] > now:
+            return holder["trainer"] == trainer_id
+        self.store.put(key, {"trainer": trainer_id, "expires": now + block_sec})
+        return True
+
+    def num_passes_finished(self):
+        return self._pass_id
+
+
+class MasterClient:
+    """go/master/client.go analog: task lease + record iteration."""
+
+    def __init__(self, endpoint_or_service=None, timeout_sec=5, local=None):
+        if local is not None or endpoint_or_service is None:
+            self._svc = local or MasterService()
+            self._call = lambda m, *a, **k: getattr(self._svc, m)(*a, **k)
+        elif isinstance(endpoint_or_service, MasterService):
+            self._svc = endpoint_or_service
+            self._call = lambda m, *a, **k: getattr(self._svc, m)(*a, **k)
+        else:
+            self._client = rpc.Client(endpoint_or_service, timeout=timeout_sec)
+            self._call = self._client.call
+        self._task = None
+        self._records = iter(())
+        self._exhausted = False
+
+    def set_dataset(self, chunk_paths):
+        self._call("set_dataset", list(chunk_paths))
+
+    def _next_task(self):
+        for _ in range(200):
+            task = self._call("get_task")
+            if task is not None:
+                return task
+            time.sleep(0.05)
+        return None
+
+    def next_record(self):
+        """One record, leasing tasks as needed (client.go:244 NextRecord).
+        Returns None when the current pass is exhausted."""
+        while True:
+            try:
+                return next(self._records)
+            except StopIteration:
+                pass
+            if self._task is not None:
+                self._call("task_finished", self._task["id"])
+                self._task = None
+            task = self._next_task()
+            if task is None:
+                return None
+
+            def gen(paths):
+                for p in paths:
+                    yield from read_records(p)
+
+            self._task = task
+            self._records = gen(task["paths"])
+
+    def task_failed(self):
+        if self._task is not None:
+            self._call("task_failed", self._task["id"])
+            self._task = None
+            self._records = iter(())

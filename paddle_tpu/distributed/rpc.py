@@ -1,0 +1,129 @@
+"""Minimal TCP RPC: length-prefixed pickled (method, args, kwargs) request /
+(ok, result-or-traceback) response.
+
+Structural stand-in for the reference's three RPC stacks (gRPC
+operators/detail/grpc_server.cc, Go net/rpc go/connection/conn.go, and the
+custom epoll LightNetwork pserver/LightNetwork.cpp) with the same role:
+DCN-side control/data plane.  Reconnection semantics follow
+go/connection/conn.go (dial retries with backoff)."""
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+import traceback
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class Server:
+    """Serve an object's public methods over TCP."""
+
+    def __init__(self, service, host="127.0.0.1", port=0):
+        self.service = service
+        svc = service
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        method, args, kwargs = _recv_msg(self.request)
+                    except (ConnectionError, EOFError):
+                        return
+                    try:
+                        fn = getattr(svc, method)
+                        result = fn(*args, **kwargs)
+                        _send_msg(self.request, (True, result))
+                    except Exception:
+                        _send_msg(self.request, (False, traceback.format_exc()))
+
+        class TS(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = TS((host, port), Handler)
+        self.addr = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def endpoint(self):
+        return f"{self.addr[0]}:{self.addr[1]}"
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class Client:
+    """Reconnecting RPC client (go/connection/conn.go analog)."""
+
+    def __init__(self, endpoint, timeout=30.0, retry_interval=0.2):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.timeout = timeout
+        self.retry_interval = retry_interval
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        deadline = time.time() + self.timeout
+        while True:
+            try:
+                s = socket.create_connection(self.addr, timeout=self.timeout)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(self.retry_interval)
+
+    def call(self, method, *args, **kwargs):
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    _send_msg(self._sock, (method, args, kwargs))
+                    ok, result = _recv_msg(self._sock)
+                    break
+                except (ConnectionError, OSError):
+                    self._sock = None
+                    if attempt:
+                        raise
+        if not ok:
+            raise RuntimeError(f"remote error calling {method}:\n{result}")
+        return result
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

@@ -1,0 +1,126 @@
+"""DistributeTranspiler — split one program into trainer/pserver halves.
+
+Reference: fluid/distribute_transpiler.py:81 — params/grads split into
+blocks round-robin over pserver endpoints (:106-145), trainer program gets
+send_op on gradients (get_trainer_program:252), pserver program gets recv_op
+plus the optimize sub-block (get_pserver_program:434) executed after N
+trainers deliver grads (recv_op.cc:100-143).
+
+TPU-native version: the trainer half is the forward+backward prefix of the
+program (ops before the backward marker; gradients come from jax.grad and
+are *fetchable* as ``<param>@GRAD``); the pserver half is the parameter
+shard assignment plus the optimizer op types/attrs extracted from the
+optimize ops — the ParameterServer executes the identical update rule
+server-side.  ``DistributedTrainer`` is the send/recv loop (the send_op /
+recv_op pair) over the RPC clients."""
+
+import copy
+
+import numpy as np
+
+from .pserver import PServerClient, assign_server
+from ..core.program import GRAD_SUFFIX
+from ..core.scope import global_scope
+
+_OPTIMIZE_OPS = {
+    "sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
+    "decayed_adagrad", "rmsprop", "ftrl", "proximal_gd", "proximal_adagrad",
+}
+
+
+class DistributeTranspiler:
+    def __init__(self):
+        self._transpiled = False
+
+    def transpile(self, program, pservers, trainers=1, trainer_id=0):
+        """pservers: endpoint list (or count).  Extract the optimize-op info
+        and compute the param→pserver assignment."""
+        self.program = program
+        self.trainers = trainers
+        self.trainer_id = trainer_id
+        if isinstance(pservers, int):
+            self.endpoints = list(range(pservers))
+        elif isinstance(pservers, str):
+            self.endpoints = pservers.split(",")
+        else:
+            self.endpoints = list(pservers)
+        n = len(self.endpoints)
+
+        block = program.global_block()
+        bw = block.backward_index
+        if bw is None:
+            raise ValueError("transpile needs a program with append_backward applied")
+        self.optimize_info = {}
+        for op in block.ops[bw:]:
+            if op.type in _OPTIMIZE_OPS:
+                pname = op.inputs["Param"][0]
+                self.optimize_info[pname] = {
+                    "op_type": op.type,
+                    "attrs": dict(op.attrs),
+                }
+        self.param_assignment = {
+            p: assign_server(p, n) for p in self.optimize_info
+        }
+        self._transpiled = True
+        return self
+
+    def get_trainer_program(self):
+        """Forward+backward only: strip the optimizer tail; grads stay
+        fetchable as <param>@GRAD."""
+        prog = copy.deepcopy(self.program)
+        block = prog.global_block()
+        bw = block.backward_index
+        kept = [
+            op for op in block.ops[bw:] if op.type not in _OPTIMIZE_OPS
+        ]
+        block.ops = block.ops[:bw] + kept
+        return prog
+
+    def get_pserver_config(self, endpoint):
+        """Which params this pserver hosts + their update rules."""
+        idx = self.endpoints.index(endpoint) if endpoint in self.endpoints else endpoint
+        return {
+            p: self.optimize_info[p]
+            for p, a in self.param_assignment.items()
+            if a == idx
+        }
+
+
+class DistributedTrainer:
+    """The send/recv loop (send_op.cc:35 / recv_op.cc:86 analog): run the
+    trainer program, push grads, pull fresh params into the Scope."""
+
+    def __init__(self, transpiler, executor, pserver_endpoints_or_servers,
+                 learning_rate=0.01):
+        self.t = transpiler
+        self.exe = executor
+        self.client = PServerClient(pserver_endpoints_or_servers)
+        self.trainer_program = transpiler.get_trainer_program()
+        self.param_names = sorted(transpiler.optimize_info)
+        self.lr = learning_rate
+        self._grad_fetch = [p + GRAD_SUFFIX for p in self.param_names]
+
+    def init_params_on_pservers(self):
+        """Trainer 0 pushes initial values (reference: trainer 0 runs the
+        startup program then InitParam RPCs)."""
+        scope = global_scope()
+        named = {p: np.asarray(scope.get(p)) for p in self.param_names}
+        first = self.param_names[0] if self.param_names else None
+        opt = (
+            self.t.optimize_info[first]["op_type"] if first else "sgd"
+        )
+        attrs = self.t.optimize_info[first]["attrs"] if first else {}
+        self.client.init_params(named, optimizer=opt, lr=self.lr, attrs=attrs)
+
+    def train_step(self, feed, extra_fetch=()):
+        """One iteration: local fwd/bwd → send grads → recv params."""
+        scope = global_scope()
+        block = self.trainer_program.global_block()
+        fetch_vars = [block.var(n) for n in self._grad_fetch] + list(extra_fetch)
+        vals = self.exe.run(self.trainer_program, feed=feed, fetch_list=fetch_vars)
+        grads = dict(zip(self.param_names, vals[: len(self.param_names)]))
+        self.client.send_grads(grads)
+        fresh = self.client.get_params(self.param_names)
+        for name, value in fresh.items():
+            scope.set(name, value)
+        return vals[len(self.param_names):]

@@ -1,0 +1,104 @@
+"""Evaluators — metrics with accumulator state in the program.
+
+Reference: fluid/evaluator.py (Accuracy, ChunkEvaluator): states are
+persistable variables updated by ops every step, so accumulation happens
+inside the jitted step; ``eval()`` reads the accumulated value and
+``reset()`` re-zeros the state arrays in the Scope.
+"""
+
+import numpy as np
+
+from .layers.layer_helper import LayerHelper
+from . import layers
+from . import initializer as init_mod
+from .core.scope import global_scope
+
+
+class Evaluator:
+    def __init__(self, name, **kwargs):
+        self.helper = LayerHelper(name, **kwargs)
+        self.states = []
+        self.metrics = []
+
+    def _create_state(self, suffix, dtype, shape):
+        var = self.helper.create_global_variable(
+            shape=shape, dtype=dtype,
+            name=f"{self.helper.name}.{suffix}",
+            initializer=init_mod.Constant(0.0),
+        )
+        self.states.append(var)
+        return var
+
+    def reset(self, executor=None):
+        scope = global_scope()
+        for state in self.states:
+            scope.set(
+                state.name,
+                np.zeros([s if s > 0 else 1 for s in state.shape], state.dtype),
+            )
+
+    def eval(self, executor=None):
+        raise NotImplementedError
+
+
+class Accuracy(Evaluator):
+    def __init__(self, input, label, k=1, **kwargs):
+        super().__init__("accuracy_eval", **kwargs)
+        self.total = self._create_state("total", "int32", [1])
+        self.correct = self._create_state("correct", "int32", [1])
+        batch_correct = self.helper.create_tmp_variable("int32", [1], stop_gradient=True)
+        batch_total = self.helper.create_tmp_variable("int32", [1], stop_gradient=True)
+        acc = layers.accuracy(input, label, k=k, correct=batch_correct, total=batch_total)
+        # accumulate
+        self.helper.append_op(
+            type="sum",
+            inputs={"X": [self.total.name, batch_total.name]},
+            outputs={"Out": [self.total.name]},
+        )
+        self.helper.append_op(
+            type="sum",
+            inputs={"X": [self.correct.name, batch_correct.name]},
+            outputs={"Out": [self.correct.name]},
+        )
+        self.metrics.append(acc)
+
+    def eval(self, executor=None):
+        scope = global_scope()
+        total = float(np.asarray(scope.get(self.total.name)).reshape(-1)[0])
+        correct = float(np.asarray(scope.get(self.correct.name)).reshape(-1)[0])
+        return correct / max(total, 1.0)
+
+
+class ChunkEvaluator(Evaluator):
+    def __init__(self, input, label, chunk_scheme="IOB", num_chunk_types=1, **kwargs):
+        super().__init__("chunk_eval", **kwargs)
+        self.num_infer = self._create_state("num_infer", "int64", [1])
+        self.num_label = self._create_state("num_label", "int64", [1])
+        self.num_correct = self._create_state("num_correct", "int64", [1])
+        (
+            precision, recall, f1, num_infer, num_label, num_correct,
+        ) = layers.chunk_eval(
+            input, label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+        )
+        for state, batch in [
+            (self.num_infer, num_infer),
+            (self.num_label, num_label),
+            (self.num_correct, num_correct),
+        ]:
+            self.helper.append_op(
+                type="sum",
+                inputs={"X": [state.name, batch.name]},
+                outputs={"Out": [state.name]},
+            )
+        self.metrics += [precision, recall, f1]
+
+    def eval(self, executor=None):
+        scope = global_scope()
+        infer = float(np.asarray(scope.get(self.num_infer.name)).reshape(-1)[0])
+        label = float(np.asarray(scope.get(self.num_label.name)).reshape(-1)[0])
+        correct = float(np.asarray(scope.get(self.num_correct.name)).reshape(-1)[0])
+        precision = correct / max(infer, 1e-12)
+        recall = correct / max(label, 1e-12)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+        return precision, recall, f1

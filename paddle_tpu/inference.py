@@ -1,0 +1,39 @@
+"""Inference engine (reference: paddle/inference/inference.{h,cc} — load
+__model__ + persistables, then Executor::Run; v2 inference.py infer())."""
+
+import numpy as np
+
+from .core.executor import Executor
+from .core.scope import Scope, scope_guard
+from . import io as _io
+from .data_feeder import DataFeeder
+
+
+class InferenceEngine:
+    """Load an exported model dir and run predictions."""
+
+    def __init__(self, dirname, place=None):
+        self.exe = Executor(place)
+        self.scope = Scope()
+        with scope_guard(self.scope):
+            (
+                self.program,
+                self.feed_names,
+                self.fetch_vars,
+            ) = _io.load_inference_model(dirname, self.exe)
+        block = self.program.global_block()
+        self.feed_vars = [block.var(n) for n in self.feed_names]
+        self.feeder = DataFeeder(self.feed_vars, place)
+
+    def run(self, feed=None, data=None):
+        """feed: {name: ndarray} or data: list of sample tuples."""
+        if data is not None:
+            feed = self.feeder.feed(data)
+        with scope_guard(self.scope):
+            return self.exe.run(
+                self.program, feed=feed, fetch_list=self.fetch_vars
+            )
+
+
+def infer(dirname, data=None, feed=None, place=None):
+    return InferenceEngine(dirname, place).run(feed=feed, data=data)

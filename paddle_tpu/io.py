@@ -1,0 +1,128 @@
+"""Model persistence.
+
+Reference: fluid/io.py — save_vars:63 / save_persistables:112 emit save_op
+per var; load_persistables:174; save_inference_model:237 (prune to
+feed/fetch + write __model__ ProgramDesc); C++ loader inference/inference.cc.
+Go-pserver checkpointing (go/pserver/service.go:342) adds CRC-checked files.
+
+Host IO can't run inside a compiled TPU program, so saving reads arrays from
+the Scope directly (one ``.npy`` per variable, like the reference's
+one-file-per-parameter layout) and ``__model__`` is the pickled Program.
+CRC32 checksums per tensor file mirror the Go checkpoint format.
+"""
+
+import os
+import pickle
+import zlib
+
+import numpy as np
+
+from .core.program import default_main_program, Parameter
+from .core.scope import global_scope
+
+
+def _is_persistable(var):
+    return var.persistable
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is None:
+        vars = [v for v in program.global_block().vars.values() if predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    manifest = {}
+    for var in vars:
+        val = scope.find_var(var.name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        fname = var.name.replace("/", "__")
+        path = os.path.join(dirname, fname)
+        np.save(path + ".npy", arr)
+        with open(path + ".npy", "rb") as f:
+            crc = zlib.crc32(f.read())
+        manifest[var.name] = {"file": fname + ".npy", "crc32": crc,
+                              "shape": list(arr.shape), "dtype": str(arr.dtype)}
+    with open(os.path.join(dirname, "__manifest__.pkl"), "wb") as f:
+        pickle.dump(manifest, f)
+
+
+def save_params(executor, dirname, main_program=None):
+    return save_vars(
+        executor, dirname, main_program,
+        predicate=lambda v: isinstance(v, Parameter),
+    )
+
+
+def save_persistables(executor, dirname, main_program=None):
+    return save_vars(executor, dirname, main_program, predicate=_is_persistable)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is None:
+        vars = [v for v in program.global_block().vars.values() if predicate(v)]
+    with open(os.path.join(dirname, "__manifest__.pkl"), "rb") as f:
+        manifest = pickle.load(f)
+    for var in vars:
+        meta = manifest.get(var.name)
+        if meta is None:
+            continue
+        path = os.path.join(dirname, meta["file"])
+        with open(path, "rb") as f:
+            data = f.read()
+        if zlib.crc32(data) != meta["crc32"]:
+            raise IOError(f"checksum mismatch for {var.name} in {dirname}")
+        arr = np.load(path, allow_pickle=False)
+        scope.set(var.name, arr)
+
+
+def load_params(executor, dirname, main_program=None):
+    return load_vars(
+        executor, dirname, main_program,
+        predicate=lambda v: isinstance(v, Parameter),
+    )
+
+
+def load_persistables(executor, dirname, main_program=None):
+    return load_vars(executor, dirname, main_program, predicate=_is_persistable)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None):
+    """Prune to the inference subgraph, save program + persistables
+    (reference save_inference_model fluid/io.py:237)."""
+    program = main_program or default_main_program()
+    pruned = program.clone(for_test=True)
+    pruned = pruned.prune(target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": pruned,
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+    }
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        pickle.dump(meta, f)
+    save_vars(
+        executor, dirname, program,
+        vars=[v for v in pruned.global_block().vars.values() if v.persistable],
+    )
+
+
+def load_inference_model(dirname, executor):
+    with open(os.path.join(dirname, "__model__"), "rb") as f:
+        meta = pickle.load(f)
+    program = meta["program"]
+    load_vars(
+        executor, dirname, program,
+        vars=[v for v in program.global_block().vars.values() if v.persistable],
+    )
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+def get_inference_program(target_vars, main_program=None):
+    program = main_program or default_main_program()
+    return program.clone(for_test=True).prune(target_vars)

@@ -1,0 +1,14 @@
+from .io import data
+from .tensor import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .device import get_places
+from . import io
+from . import tensor
+from . import nn
+from . import ops
+from . import control_flow
+from . import detection
+from . import device

@@ -1,0 +1,231 @@
+"""Control-flow layers.
+
+Reference: fluid/layers/control_flow.py (16 constructs incl. While:583,
+StaticRNN, array ops, less_than, increment).  Sub-blocks are recorded in the
+program and lowered to lax.while_loop / lax.scan by the control-flow ops
+(ops/control_flow_ops.py) — structured, compiled control flow instead of
+interpreter re-entry.
+"""
+
+import contextlib
+
+from ..core.program import default_main_program
+from ..core import unique_name
+from .layer_helper import LayerHelper, seq_length
+from . import tensor as tensor_layers
+
+__all__ = [
+    "While",
+    "StaticRNN",
+    "DynamicRNN",
+    "array_write",
+    "array_read",
+    "array_length",
+    "create_array",
+    "increment",
+    "less_than",
+    "max_sequence_len",
+    "ParallelDo",
+]
+
+from .ops import less_than  # re-export (compare layer lives in ops)
+from .tensor import increment
+
+
+def create_array(dtype, max_len, shape):
+    """A preallocated tensor array [max_len, ...] — the LoDTensorArray
+    analog with static capacity."""
+    return tensor_layers.fill_constant([max_len] + list(shape), dtype, 0.0)
+
+
+def array_write(x, i, array):
+    helper = LayerHelper("array_write")
+    helper.append_op(
+        type="array_write",
+        inputs={"X": [x.name], "I": [i.name], "Array": [array.name]},
+        outputs={"Out": [array.name]},
+    )
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_tmp_variable(array.dtype, list(array.shape[1:]))
+    helper.append_op(
+        type="array_read",
+        inputs={"Array": [array.name], "I": [i.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_tmp_variable("int64", [1], stop_gradient=True)
+    helper.append_op(
+        type="array_length", inputs={"Array": [array.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def max_sequence_len(x):
+    """Max length of a padded sequence batch (max_sequence_len_op analog)."""
+    helper = LayerHelper("max_sequence_len")
+    ln = seq_length(x)
+    out = helper.create_tmp_variable("int32", [1], stop_gradient=True)
+    helper.append_op(
+        type="reduce_max", inputs={"X": [ln.name]}, outputs={"Out": [out.name]},
+        attrs={"reduce_all": True, "keep_dim": True},
+    )
+    return out
+
+
+class While:
+    """while-loop construct (control_flow.py:583).
+
+    with While(cond).block():
+        ...ops...
+        # update cond inside the block
+    Carried state = condition + every var written in the block that existed
+    before it; shapes must stay constant (XLA).
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+
+    @contextlib.contextmanager
+    def block(self):
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        sub = prog.create_block()
+        yield
+        prog.rollback()
+        parent.append_op(
+            type="while",
+            inputs={"Condition": [self.cond_var.name]},
+            outputs={},
+            attrs={"sub_block": sub.idx},
+        )
+
+
+class StaticRNN:
+    """Scan-based RNN builder (control_flow.py StaticRNN): step inputs are
+    time-slices of sequence tensors; memories are loop-carried."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._x_outer = []
+        self._x_inner = []
+        self._init_outer = []
+        self._state_names = []
+        self._out_names = []
+        self._outputs = []
+        self._sub = None
+        self._parent = None
+
+    @contextlib.contextmanager
+    def step(self):
+        prog = self.helper.main_program
+        self._parent = prog.current_block()
+        self._sub = prog.create_block()
+        yield
+        prog.rollback()
+        self._parent.append_op(
+            type="scan_block",
+            inputs={"X": self._x_outer, "Init": self._init_outer},
+            outputs={"Out": [o.name for o in self._outputs]},
+            attrs={
+                "sub_block": self._sub.idx,
+                "x_names": self._x_inner,
+                "state_names": self._state_names,
+                "out_names": self._out_names,
+                "reverse": False,
+            },
+        )
+
+    def step_input(self, x):
+        """x: [b, t, ...] sequence var; returns the per-step slice [b, ...]."""
+        inner = self._sub.create_var(
+            name=unique_name.generate(f"{self.helper.name}.step_in"),
+            dtype=x.dtype,
+            shape=[x.shape[0]] + list(x.shape[2:]),
+        )
+        self._x_outer.append(x.name)
+        self._x_inner.append(inner.name)
+        return inner
+
+    def memory(self, init):
+        """Loop-carried state initialized from ``init`` [b, d]."""
+        mem = self._sub.create_var(
+            name=unique_name.generate(f"{self.helper.name}.mem"),
+            dtype=init.dtype,
+            shape=list(init.shape),
+        )
+        self._init_outer.append(init.name)
+        self._state_names.append(mem.name)
+        return mem
+
+    def update_memory(self, mem, new_val):
+        self._sub.append_op(
+            type="assign", inputs={"X": [new_val.name]}, outputs={"Out": [mem.name]}
+        )
+
+    def step_output(self, o):
+        self._out_names.append(o.name)
+        outer = self._parent.create_var(
+            name=unique_name.generate(f"{self.helper.name}.out"),
+            dtype=o.dtype,
+            shape=[o.shape[0], -1] + list(o.shape[1:]),
+        )
+        self._outputs.append(outer)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return self._outputs
+
+
+# DynamicRNN in the reference sorts by length into a rank table
+# (lod_rank_table_op) and shrinks the batch each step; on TPU padded+masked
+# scan (StaticRNN over padded batch, mask from @LENGTH) is the efficient
+# equivalent, so DynamicRNN is StaticRNN with automatic masking.
+DynamicRNN = StaticRNN
+
+
+class ParallelDo:
+    """Reference parallel_do (fluid/layers/control_flow.py ParallelDo):
+    scatter over places, run block per place, gather.  On TPU the same
+    program is SPMD-sharded over the mesh, so this construct records its
+    block and lowers to inline execution; pair it with
+    paddle_tpu.parallel.data_parallel() for actual multi-chip running."""
+
+    def __init__(self, places=None, name=None):
+        self.helper = LayerHelper("parallel_do", name=name)
+        self._inputs = []
+
+    @contextlib.contextmanager
+    def do(self):
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        sub = prog.create_block()
+        yield
+        prog.rollback()
+        parent.append_op(
+            type="parallel_do",
+            inputs={"X": self._inputs},
+            outputs={},
+            attrs={"sub_block": sub.idx},
+        )
+
+    def read_input(self, x):
+        self._inputs.append(x.name)
+        return x
+
+    def write_output(self, o):
+        return o

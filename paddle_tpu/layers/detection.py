@@ -1,0 +1,104 @@
+"""Detection layers wrapping the detection op group (reference ops:
+prior_box_op, iou_similarity_op, bipartite_match_op, roi_pool_op,
+detection_output)."""
+
+from .layer_helper import LayerHelper
+
+__all__ = ["prior_box", "iou_similarity", "bipartite_match", "roi_pool",
+           "detection_output"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("prior_box", name=name)
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    p = len(min_sizes) * len(ars) + len(max_sizes or [])
+    h, w = input.shape[2], input.shape[3]
+    boxes = helper.create_tmp_variable("float32", [h, w, p, 4], stop_gradient=True)
+    var = helper.create_tmp_variable("float32", [h, w, p, 4], stop_gradient=True)
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input.name], "Image": [image.name]},
+        outputs={"Boxes": [boxes.name], "Variances": [var.name]},
+        attrs={
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variances),
+            "flip": flip,
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+        },
+    )
+    return boxes, var
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_tmp_variable("float32", [x.shape[0], y.shape[0]], stop_gradient=True)
+    helper.append_op(
+        type="iou_similarity", inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def bipartite_match(dist_matrix, name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    m = dist_matrix.shape[1]
+    idx = helper.create_tmp_variable("int32", [1, m], stop_gradient=True)
+    dist = helper.create_tmp_variable("float32", [1, m], stop_gradient=True)
+    helper.append_op(
+        type="bipartite_match",
+        inputs={"DistMat": [dist_matrix.name]},
+        outputs={"ColToRowMatchIndices": [idx.name], "ColToRowMatchDist": [dist.name]},
+    )
+    return idx, dist
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    c = input.shape[1]
+    r = rois.shape[0]
+    out = helper.create_tmp_variable(input.dtype, [r, c, pooled_height, pooled_width])
+    argmax = helper.create_tmp_variable("int64", [r, c, pooled_height, pooled_width], stop_gradient=True)
+    helper.append_op(
+        type="roi_pool",
+        inputs={"X": [input.name], "ROIs": [rois.name]},
+        outputs={"Out": [out.name], "Argmax": [argmax.name]},
+        attrs={
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+        },
+    )
+    return out
+
+
+def detection_output(loc, scores, prior_box, background_label=0,
+                     nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, name=None):
+    helper = LayerHelper("detection_output", name=name)
+    out = helper.create_tmp_variable("float32", [scores.shape[0], keep_top_k, 6], stop_gradient=True)
+    helper.append_op(
+        type="detection_output",
+        inputs={"Loc": [loc.name], "Conf": [scores.name], "PriorBox": [prior_box.name]},
+        outputs={"Out": [out.name]},
+        attrs={
+            "background_label": background_label,
+            "nms_threshold": nms_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "score_threshold": score_threshold,
+        },
+    )
+    return out

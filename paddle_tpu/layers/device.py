@@ -1,0 +1,17 @@
+"""Device layers (reference: fluid/layers/device.py:26 get_places — feeds
+parallel_do's PLACE_LIST).  On TPU the analog of a place list is the device
+mesh; parallelism is sharding, not scattering, so this returns the devices
+for introspection only."""
+
+import jax
+
+
+def get_places(device_count=None, device_type=None):
+    devs = jax.devices()
+    if device_type == "CPU":
+        devs = [d for d in devs if d.platform == "cpu"]
+    elif device_type in ("TPU", "GPU", "CUDA"):
+        devs = [d for d in devs if d.platform != "cpu"]
+    if device_count:
+        devs = devs[:device_count]
+    return devs

@@ -1,0 +1,1173 @@
+"""Neural-net layers (reference: python/paddle/v2/fluid/layers/nn.py — fc:70,
+embedding:191, dynamic_lstm:250, conv2d:913, batch_norm:1251, …).  Each layer
+creates parameters through LayerHelper and appends ops; sequence-aware layers
+wire the shadow ``@LENGTH`` variables automatically (the LoD replacement)."""
+
+import numpy as np
+
+from ..core.program import Variable
+from ..param_attr import ParamAttr
+from .. import initializer as init_mod
+from .layer_helper import LayerHelper, seq_length
+
+__all__ = [
+    "link_sequence",
+    "fc",
+    "embedding",
+    "dynamic_lstm",
+    "dynamic_lstmp",
+    "dynamic_gru",
+    "gru_unit",
+    "lstm_unit",
+    "conv2d",
+    "conv2d_transpose",
+    "pool2d",
+    "batch_norm",
+    "layer_norm",
+    "dropout",
+    "cross_entropy",
+    "square_error_cost",
+    "accuracy",
+    "auc",
+    "chunk_eval",
+    "sequence_conv",
+    "sequence_pool",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_expand",
+    "sequence_reshape",
+    "sequence_softmax",
+    "softmax",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "smooth_l1",
+    "matmul",
+    "mul",
+    "topk",
+    "warpctc",
+    "ctc_greedy_decoder",
+    "edit_distance",
+    "l2_normalize",
+    "im2sequence",
+    "nce",
+    "row_conv",
+    "multiplex",
+    "linear_chain_crf",
+    "crf_decoding",
+    "cos_sim",
+    "mean",
+    "scale",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_max",
+    "reduce_min",
+    "reduce_prod",
+    "clip",
+    "clip_by_norm",
+    "beam_search",
+    "lrn",
+    "maxout",
+    "spp",
+]
+
+
+def _seq_inputs(inputs, x):
+    ln = seq_length(x)
+    if ln is not None:
+        inputs["Length"] = [ln.name]
+    return ln
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None, **kwargs):
+    helper = LayerHelper("fc", bias_attr=bias_attr, act=act, name=name, **kwargs)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_results = []
+    for x in inputs:
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        w = helper.create_parameter(
+            param_attr, shape=[in_dim, size], dtype=x.dtype, suffix="w"
+        )
+        out_shape = list(x.shape[:num_flatten_dims]) + [size]
+        tmp = helper.create_tmp_variable(x.dtype, out_shape, lod_level=x.lod_level)
+        helper.append_op(
+            type="mul",
+            inputs={"X": [x.name], "Y": [w.name]},
+            outputs={"Out": [tmp.name]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(
+            mul_results[0].dtype, mul_results[0].shape,
+            lod_level=mul_results[0].lod_level,
+        )
+        helper.append_op(
+            type="sum", inputs={"X": mul_results}, outputs={"Out": [pre_bias.name]}
+        )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=len(pre_bias.shape) - 1)
+    out = helper.append_activation(pre_act)
+    out.lod_level = inputs[0].lod_level
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32", name=None):
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(
+        param_attr, shape=list(size), dtype=dtype, suffix="w",
+        default_initializer=init_mod.Uniform(-0.05, 0.05),
+    )
+    ishape = list(input.shape)
+    if ishape and ishape[-1] == 1:
+        ishape = ishape[:-1]
+    out = helper.create_tmp_variable(dtype, ishape + [size[1]], lod_level=input.lod_level)
+    helper.append_op(
+        type="lookup_table",
+        inputs={"W": [w.name], "Ids": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={
+            "is_sparse": is_sparse,
+            "padding_idx": -1 if padding_idx is None else padding_idx,
+        },
+    )
+    if input.lod_level > 0:
+        # propagate sequence lengths to the embedded output
+        out.block.vars[out.name + "@LENGTH"] = input.length_var()
+        out.lod_level = input.lod_level
+    return out
+
+
+def _link_length(out, src):
+    """Make ``out`` share ``src``'s sequence-length variable."""
+    if getattr(src, "lod_level", 0) > 0:
+        out.block.vars.setdefault(out.name + "@LENGTH", src.length_var())
+        out.lod_level = src.lod_level
+    return out
+
+
+def link_sequence(out, src):
+    """Public helper: mark ``out`` as a sequence batch sharing ``src``'s
+    lengths (useful after shape-preserving layers like fc with
+    num_flatten_dims=2)."""
+    return _link_length(out, src)
+
+
+def dynamic_lstm(input, size, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", param_attr=None, bias_attr=None,
+                 name=None):
+    """LSTM over a padded sequence batch [b, t, 4d] (input pre-projected to
+    4*hidden, reference dynamic_lstm nn.py:250).  size = 4*hidden."""
+    helper = LayerHelper("lstm", name=name)
+    d = size // 4
+    weight = helper.create_parameter(param_attr, shape=[d, 4 * d], dtype=input.dtype)
+    bias_size = 7 * d if use_peepholes else 4 * d
+    bias = helper.create_parameter(
+        ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[1, bias_size],
+        dtype=input.dtype, suffix="b", default_initializer=init_mod.Constant(0.0),
+    )
+    hidden = helper.create_tmp_variable(
+        input.dtype, list(input.shape[:2]) + [d], lod_level=input.lod_level
+    )
+    cell = helper.create_tmp_variable(
+        input.dtype, list(input.shape[:2]) + [d], lod_level=input.lod_level
+    )
+    inputs = {"Input": [input.name], "Weight": [weight.name], "Bias": [bias.name]}
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="lstm",
+        inputs=inputs,
+        outputs={"Hidden": [hidden.name], "Cell": [cell.name]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    _link_length(hidden, input)
+    _link_length(cell, input)
+    return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  param_attr=None, bias_attr=None, name=None):
+    helper = LayerHelper("lstmp", name=name)
+    d = size // 4
+    weight = helper.create_parameter(
+        param_attr, shape=[proj_size, 4 * d], dtype=input.dtype
+    )
+    proj_weight = helper.create_parameter(
+        param_attr, shape=[d, proj_size], dtype=input.dtype, suffix="proj_w"
+    )
+    bias_size = 7 * d if use_peepholes else 4 * d
+    bias = helper.create_parameter(
+        ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[1, bias_size],
+        dtype=input.dtype, suffix="b", default_initializer=init_mod.Constant(0.0),
+    )
+    proj = helper.create_tmp_variable(
+        input.dtype, list(input.shape[:2]) + [proj_size], lod_level=input.lod_level
+    )
+    inputs = {
+        "Input": [input.name],
+        "Weight": [weight.name],
+        "ProjWeight": [proj_weight.name],
+        "Bias": [bias.name],
+    }
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="lstmp",
+        inputs=inputs,
+        outputs={"Projection": [proj.name]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+            "proj_activation": proj_activation,
+        },
+    )
+    return _link_length(proj, input)
+
+
+def dynamic_gru(input, size, is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", param_attr=None, bias_attr=None,
+                h_0=None, name=None):
+    """GRU over padded batch [b, t, 3d]; size = hidden d."""
+    helper = LayerHelper("gru", name=name)
+    d = size
+    weight = helper.create_parameter(param_attr, shape=[d, 3 * d], dtype=input.dtype)
+    bias = helper.create_parameter(
+        ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[1, 3 * d],
+        dtype=input.dtype, suffix="b", default_initializer=init_mod.Constant(0.0),
+    )
+    hidden = helper.create_tmp_variable(
+        input.dtype, list(input.shape[:2]) + [d], lod_level=input.lod_level
+    )
+    inputs = {"Input": [input.name], "Weight": [weight.name], "Bias": [bias.name]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="gru",
+        inputs=inputs,
+        outputs={"Hidden": [hidden.name]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    return _link_length(hidden, input)
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """One GRU step (nn.py gru_unit); size = 3*hidden_dim."""
+    helper = LayerHelper("gru_unit")
+    d = size // 3
+    weight = helper.create_parameter(param_attr, shape=[d, 3 * d], dtype=input.dtype)
+    bias = helper.create_parameter(
+        ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[1, 3 * d],
+        dtype=input.dtype, suffix="b", default_initializer=init_mod.Constant(0.0),
+    )
+    out = helper.create_tmp_variable(input.dtype, list(hidden.shape))
+    helper.append_op(
+        type="gru_unit",
+        inputs={
+            "Input": [input.name],
+            "HiddenPrev": [hidden.name],
+            "Weight": [weight.name],
+            "Bias": [bias.name],
+        },
+        outputs={"Hidden": [out.name]},
+        attrs={"activation": activation, "gate_activation": gate_activation},
+    )
+    return out
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None):
+    """One LSTM step with its own input projection (nn.py lstm_unit)."""
+    d = cell_t_prev.shape[-1]
+    gates = fc([x_t, hidden_t_prev], size=4 * d, param_attr=param_attr,
+               bias_attr=bias_attr if bias_attr is not None else ParamAttr())
+    helper = LayerHelper("lstm_unit")
+    c = helper.create_tmp_variable(x_t.dtype, list(cell_t_prev.shape))
+    h = helper.create_tmp_variable(x_t.dtype, list(cell_t_prev.shape))
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [gates.name], "C_prev": [cell_t_prev.name]},
+        outputs={"C": [c.name], "H": [h.name]},
+        attrs={"forget_bias": float(forget_bias)},
+    )
+    return h, c
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv2d", bias_attr=bias_attr, act=act, name=name)
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    cin = input.shape[1]
+    w = helper.create_parameter(
+        param_attr,
+        shape=[num_filters, cin // groups, filter_size[0], filter_size[1]],
+        dtype=input.dtype,
+        default_initializer=init_mod.MSRA(uniform=False),
+    )
+
+    def osize(i, k, s, p, d):
+        if i < 0:
+            return -1
+        eff = (k - 1) * d + 1
+        return (i + 2 * p - eff) // s + 1
+
+    oh = osize(input.shape[2], filter_size[0], stride[0], padding[0], dilation[0])
+    ow = osize(input.shape[3], filter_size[1], stride[1], padding[1], dilation[1])
+    pre_bias = helper.create_tmp_variable(
+        input.dtype, [input.shape[0], num_filters, oh, ow]
+    )
+    helper.append_op(
+        type="conv2d",
+        inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Output": [pre_bias.name]},
+        attrs={
+            "strides": list(stride),
+            "paddings": list(padding),
+            "dilations": list(dilation),
+            "groups": groups,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, param_attr=None, bias_attr=None, act=None,
+                     name=None):
+    helper = LayerHelper("conv2d_transpose", bias_attr=bias_attr, act=act, name=name)
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    cin = input.shape[1]
+    w = helper.create_parameter(
+        param_attr, shape=[cin, num_filters, filter_size[0], filter_size[1]],
+        dtype=input.dtype,
+    )
+
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def osize(i, k, s, p, d):
+        if i < 0:
+            return -1
+        eff = (k - 1) * d + 1
+        return (i - 1) * s - 2 * p + eff
+
+    oh = osize(input.shape[2], filter_size[0], stride[0], padding[0], dilation[0])
+    ow = osize(input.shape[3], filter_size[1], stride[1], padding[1], dilation[1])
+    pre_bias = helper.create_tmp_variable(
+        input.dtype, [input.shape[0], num_filters, oh, ow]
+    )
+    helper.append_op(
+        type="conv2d_transpose",
+        inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Output": [pre_bias.name]},
+        attrs={"strides": list(stride), "paddings": list(padding),
+               "dilations": list(dilation)},
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    k = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
+    s = (pool_stride, pool_stride) if isinstance(pool_stride, int) else tuple(pool_stride)
+    p = (pool_padding, pool_padding) if isinstance(pool_padding, int) else tuple(pool_padding)
+
+    def osize(i, kk, ss, pp):
+        if i < 0:
+            return -1
+        if global_pooling:
+            return 1
+        if ceil_mode:
+            return (i + 2 * pp - kk + ss - 1) // ss + 1
+        return (i + 2 * pp - kk) // ss + 1
+
+    oh = osize(input.shape[2], k[0], s[0], p[0])
+    ow = osize(input.shape[3], k[1], s[1], p[1])
+    out = helper.create_tmp_variable(input.dtype, [input.shape[0], input.shape[1], oh, ow])
+    helper.append_op(
+        type="pool2d",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={
+            "ksize": list(k),
+            "strides": list(s),
+            "paddings": list(p),
+            "pooling_type": pool_type,
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+        },
+    )
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("batch_norm", act=act, name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = "float32"  # stats and affine params in f32 even for bf16 activations
+    scale = helper.create_parameter(
+        param_attr, shape=[c], dtype=dtype, suffix="scale",
+        default_initializer=init_mod.Constant(1.0),
+    )
+    bias = helper.create_parameter(
+        ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[c], dtype=dtype,
+        suffix="offset", default_initializer=init_mod.Constant(0.0),
+    )
+    mean = helper.create_global_variable(
+        shape=[c], dtype=dtype, name=f"{helper.name}.mean",
+        initializer=init_mod.Constant(0.0),
+    )
+    variance = helper.create_global_variable(
+        shape=[c], dtype=dtype, name=f"{helper.name}.variance",
+        initializer=init_mod.Constant(1.0),
+    )
+    saved_mean = helper.create_tmp_variable(dtype, [c], stop_gradient=True)
+    saved_var = helper.create_tmp_variable(dtype, [c], stop_gradient=True)
+    out = helper.create_tmp_variable(input.dtype, list(input.shape))
+    helper.append_op(
+        type="batch_norm",
+        inputs={
+            "X": [input.name],
+            "Scale": [scale.name],
+            "Bias": [bias.name],
+            "Mean": [mean.name],
+            "Variance": [variance.name],
+        },
+        outputs={
+            "Y": [out.name],
+            "MeanOut": [mean.name],
+            "VarianceOut": [variance.name],
+            "SavedMean": [saved_mean.name],
+            "SavedVariance": [saved_var.name],
+        },
+        attrs={
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test,
+            "data_layout": data_layout,
+        },
+    )
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("layer_norm", act=act, name=name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input.name]}
+    if scale:
+        s = helper.create_parameter(
+            param_attr, shape=norm_shape, dtype=input.dtype, suffix="scale",
+            default_initializer=init_mod.Constant(1.0),
+        )
+        inputs["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(
+            ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=norm_shape,
+            dtype=input.dtype, suffix="bias",
+            default_initializer=init_mod.Constant(0.0),
+        )
+        inputs["Bias"] = [b.name]
+    out = helper.create_tmp_variable(input.dtype, list(input.shape))
+    mean = helper.create_tmp_variable("float32", list(input.shape[:begin_norm_axis]), stop_gradient=True)
+    var = helper.create_tmp_variable("float32", list(input.shape[:begin_norm_axis]), stop_gradient=True)
+    helper.append_op(
+        type="layer_norm",
+        inputs=inputs,
+        outputs={"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
+        attrs={"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
+    )
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=0, name=None):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(x.dtype, list(x.shape), lod_level=x.lod_level)
+    mask = helper.create_tmp_variable(x.dtype, list(x.shape), stop_gradient=True)
+    helper.append_op(
+        type="dropout",
+        inputs={"X": [x.name]},
+        outputs={"Out": [out.name], "Mask": [mask.name]},
+        attrs={
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "seed": seed,
+            "fix_seed": bool(seed),
+        },
+    )
+    return _link_length(out, x)
+
+
+def cross_entropy(input, label, soft_label=False):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_tmp_variable(input.dtype, list(input.shape[:-1]) + [1])
+    helper.append_op(
+        type="cross_entropy",
+        inputs={"X": [input.name], "Label": [label.name]},
+        outputs={"Y": [out.name]},
+        attrs={"soft_label": soft_label},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax_out = helper.create_tmp_variable(logits.dtype, list(logits.shape))
+    loss = helper.create_tmp_variable(logits.dtype, list(logits.shape[:-1]) + [1])
+    helper.append_op(
+        type="softmax_with_cross_entropy",
+        inputs={"Logits": [logits.name], "Label": [label.name]},
+        outputs={"Softmax": [softmax_out.name], "Loss": [loss.name]},
+        attrs={"soft_label": soft_label},
+    )
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits")
+    out = helper.create_tmp_variable(x.dtype, list(x.shape))
+    helper.append_op(
+        type="sigmoid_cross_entropy_with_logits",
+        inputs={"X": [x.name], "Label": [label.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    helper = LayerHelper("smooth_l1")
+    out = helper.create_tmp_variable(x.dtype, [x.shape[0], 1])
+    diff = helper.create_tmp_variable(x.dtype, list(x.shape), stop_gradient=True)
+    inputs = {"X": [x.name], "Y": [y.name]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight.name]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight.name]
+    helper.append_op(
+        type="smooth_l1_loss",
+        inputs=inputs,
+        outputs={"Out": [out.name], "Diff": [diff.name]},
+        attrs={"sigma": sigma},
+    )
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    minus_out = helper.create_tmp_variable(input.dtype, list(input.shape))
+    helper.append_op(
+        type="elementwise_sub",
+        inputs={"X": [input.name], "Y": [label.name]},
+        outputs={"Out": [minus_out.name]},
+    )
+    out = helper.create_tmp_variable(input.dtype, list(input.shape))
+    helper.append_op(
+        type="square", inputs={"X": [minus_out.name]}, outputs={"Out": [out.name]}
+    )
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_tmp_variable(input.dtype, [input.shape[0], k])
+    topk_indices = helper.create_tmp_variable("int64", [input.shape[0], k], stop_gradient=True)
+    helper.append_op(
+        type="top_k",
+        inputs={"X": [input.name]},
+        outputs={"Out": [topk_out.name], "Indices": [topk_indices.name]},
+        attrs={"k": k},
+    )
+    acc_out = helper.create_tmp_variable("float32", [1], stop_gradient=True)
+    correct = correct or helper.create_tmp_variable("int32", [1], stop_gradient=True)
+    total = total or helper.create_tmp_variable("int32", [1], stop_gradient=True)
+    helper.append_op(
+        type="accuracy",
+        inputs={
+            "Out": [topk_out.name],
+            "Indices": [topk_indices.name],
+            "Label": [label.name],
+        },
+        outputs={
+            "Accuracy": [acc_out.name],
+            "Correct": [correct.name],
+            "Total": [total.name],
+        },
+    )
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200):
+    helper = LayerHelper("auc")
+    out = helper.create_tmp_variable("float32", [1], stop_gradient=True)
+    helper.append_op(
+        type="auc",
+        inputs={"Out": [input.name], "Label": [label.name]},
+        outputs={"AUC": [out.name]},
+        attrs={"curve": curve, "num_thresholds": num_thresholds},
+    )
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=1):
+    helper = LayerHelper("chunk_eval")
+    outs = {
+        n: helper.create_tmp_variable(
+            "float32" if i < 3 else "int64", [1], stop_gradient=True
+        )
+        for i, n in enumerate(
+            ["Precision", "Recall", "F1-Score", "NumInferChunks",
+             "NumLabelChunks", "NumCorrectChunks"]
+        )
+    }
+    inputs = {"Inference": [input.name], "Label": [label.name]}
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="chunk_eval",
+        inputs=inputs,
+        outputs={k: [v.name] for k, v in outs.items()},
+        attrs={"chunk_scheme": chunk_scheme, "num_chunk_types": num_chunk_types},
+    )
+    return (
+        outs["Precision"], outs["Recall"], outs["F1-Score"],
+        outs["NumInferChunks"], outs["NumLabelChunks"], outs["NumCorrectChunks"],
+    )
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, param_attr=None, bias_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper("sequence_conv", bias_attr=bias_attr, act=act, name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        param_attr, shape=[filter_size * d, num_filters], dtype=input.dtype
+    )
+    out = helper.create_tmp_variable(
+        input.dtype, list(input.shape[:2]) + [num_filters], lod_level=input.lod_level
+    )
+    inputs = {"X": [input.name], "Filter": [w.name]}
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="sequence_conv",
+        inputs=inputs,
+        outputs={"Out": [out.name]},
+        attrs={"contextLength": filter_size, "contextStart": -(filter_size // 2)},
+    )
+    _link_length(out, input)
+    pre_act = helper.append_bias_op(out, dim_start=len(out.shape) - 1)
+    return helper.append_activation(pre_act)
+
+
+def sequence_pool(input, pool_type):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_tmp_variable(input.dtype, [input.shape[0]] + list(input.shape[2:]))
+    inputs = {"X": [input.name]}
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="sequence_pool",
+        inputs=inputs,
+        outputs={"Out": [out.name]},
+        attrs={"pooltype": pool_type.upper()},
+    )
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_expand(x, y, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    t = y.shape[1] if len(y.shape) > 1 else -1
+    out = helper.create_tmp_variable(
+        x.dtype, [x.shape[0], t] + list(x.shape[1:]), lod_level=1
+    )
+    inputs = {"X": [x.name], "Y": [y.name]}
+    yl = seq_length(y)
+    if yl is not None:
+        inputs["YLength"] = [yl.name]
+        out.block.vars[out.name + "@LENGTH"] = yl
+    helper.append_op(type="sequence_expand", inputs=inputs, outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    b, t, d = input.shape
+    new_t = t * d // new_dim if t >= 0 else -1
+    out = helper.create_tmp_variable(input.dtype, [b, new_t, new_dim], lod_level=1)
+    inputs = {"X": [input.name]}
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="sequence_reshape",
+        inputs=inputs,
+        outputs={"Out": [out.name], "OutLength": [out.length_var().name]},
+        attrs={"new_dim": new_dim},
+    )
+    return out
+
+
+def sequence_softmax(x, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_tmp_variable(x.dtype, list(x.shape), lod_level=x.lod_level)
+    inputs = {"X": [x.name]}
+    _seq_inputs(inputs, x)
+    helper.append_op(
+        type="sequence_softmax", inputs=inputs, outputs={"Out": [out.name]}
+    )
+    return _link_length(out, x)
+
+
+def softmax(x, name=None):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_tmp_variable(x.dtype, list(x.shape))
+    helper.append_op(type="softmax", inputs={"X": [x.name]}, outputs={"Out": [out.name]})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    helper = LayerHelper("matmul", name=name)
+    xs = list(x.shape)
+    ys = list(y.shape)
+    if transpose_x:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if transpose_y:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    out_shape = xs[:-1] + ys[-1:]
+    out = helper.create_tmp_variable(x.dtype, out_shape)
+    helper.append_op(
+        type="matmul",
+        inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [out.name]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y},
+    )
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    helper = LayerHelper("mul")
+    out_shape = list(x.shape[:x_num_col_dims]) + list(y.shape[y_num_col_dims:])
+    out = helper.create_tmp_variable(x.dtype, out_shape)
+    helper.append_op(
+        type="mul",
+        inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [out.name]},
+        attrs={"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def topk(input, k):
+    helper = LayerHelper("top_k")
+    vals = helper.create_tmp_variable(input.dtype, list(input.shape[:-1]) + [k])
+    idx = helper.create_tmp_variable("int64", list(input.shape[:-1]) + [k], stop_gradient=True)
+    helper.append_op(
+        type="top_k",
+        inputs={"X": [input.name]},
+        outputs={"Out": [vals.name], "Indices": [idx.name]},
+        attrs={"k": k},
+    )
+    return vals, idx
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    helper = LayerHelper("warpctc")
+    loss = helper.create_tmp_variable(input.dtype, [input.shape[0], 1])
+    inputs = {"Logits": [input.name], "Label": [label.name]}
+    il = seq_length(input)
+    ll = seq_length(label)
+    if il is not None:
+        inputs["LogitsLength"] = [il.name]
+    if ll is not None:
+        inputs["LabelLength"] = [ll.name]
+    helper.append_op(
+        type="warpctc",
+        inputs=inputs,
+        outputs={"Loss": [loss.name]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss
+
+
+def ctc_greedy_decoder(input, blank):
+    helper = LayerHelper("ctc_greedy_decoder")
+    # input: [b, t, V] probs -> argmax ids -> collapse
+    ids = helper.create_tmp_variable("int64", list(input.shape[:2]), stop_gradient=True)
+    helper.append_op(
+        type="arg_max", inputs={"X": [input.name]}, outputs={"Out": [ids.name]},
+        attrs={"axis": -1},
+    )
+    out = helper.create_tmp_variable("int64", list(input.shape[:2]), lod_level=1, stop_gradient=True)
+    inputs = {"Input": [ids.name]}
+    il = seq_length(input)
+    if il is not None:
+        inputs["Length"] = [il.name]
+    helper.append_op(
+        type="ctc_align",
+        inputs=inputs,
+        outputs={"Output": [out.name], "OutputLength": [out.length_var().name]},
+        attrs={"blank": blank, "merge_repeated": True},
+    )
+    return out
+
+
+def edit_distance(input, label, normalized=False, ignored_tokens=None):
+    helper = LayerHelper("edit_distance")
+    hyp, ref = input, label
+    if ignored_tokens:
+        for var in (hyp, ref):
+            pass  # handled by sequence_erase below
+        new_hyp = helper.create_tmp_variable(hyp.dtype, list(hyp.shape), lod_level=1, stop_gradient=True)
+        inputs = {"X": [hyp.name]}
+        hl = seq_length(hyp)
+        if hl is not None:
+            inputs["Length"] = [hl.name]
+        helper.append_op(
+            type="sequence_erase", inputs=inputs,
+            outputs={"Out": [new_hyp.name], "OutLength": [new_hyp.length_var().name]},
+            attrs={"tokens": list(ignored_tokens)},
+        )
+        hyp = new_hyp
+        new_ref = helper.create_tmp_variable(ref.dtype, list(ref.shape), lod_level=1, stop_gradient=True)
+        inputs = {"X": [ref.name]}
+        rl = seq_length(ref)
+        if rl is not None:
+            inputs["Length"] = [rl.name]
+        helper.append_op(
+            type="sequence_erase", inputs=inputs,
+            outputs={"Out": [new_ref.name], "OutLength": [new_ref.length_var().name]},
+            attrs={"tokens": list(ignored_tokens)},
+        )
+        ref = new_ref
+    out = helper.create_tmp_variable("float32", [input.shape[0], 1], stop_gradient=True)
+    seq_num = helper.create_tmp_variable("int64", [1], stop_gradient=True)
+    inputs = {"Hyps": [hyp.name], "Refs": [ref.name]}
+    hl, rl = seq_length(hyp), seq_length(ref)
+    if hl is not None:
+        inputs["HypsLength"] = [hl.name]
+    if rl is not None:
+        inputs["RefsLength"] = [rl.name]
+    helper.append_op(
+        type="edit_distance",
+        inputs=inputs,
+        outputs={"Out": [out.name], "SequenceNum": [seq_num.name]},
+        attrs={"normalized": normalized},
+    )
+    return out, seq_num
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    square = helper.create_tmp_variable(x.dtype, list(x.shape))
+    helper.append_op(type="square", inputs={"X": [x.name]}, outputs={"Out": [square.name]})
+    ssum = helper.create_tmp_variable(x.dtype, [s if i != axis % len(x.shape) else 1 for i, s in enumerate(x.shape)])
+    helper.append_op(
+        type="reduce_sum", inputs={"X": [square.name]}, outputs={"Out": [ssum.name]},
+        attrs={"dim": axis, "keep_dim": True},
+    )
+    eps = helper.create_tmp_variable(x.dtype, [1])
+    helper.append_op(
+        type="fill_constant", outputs={"Out": [eps.name]},
+        attrs={"shape": [1], "dtype": str(x.dtype.name), "value": float(epsilon)},
+    )
+    maxed = helper.create_tmp_variable(x.dtype, ssum.shape)
+    helper.append_op(
+        type="elementwise_max", inputs={"X": [ssum.name], "Y": [eps.name]},
+        outputs={"Out": [maxed.name]},
+    )
+    rsq = helper.create_tmp_variable(x.dtype, ssum.shape)
+    helper.append_op(type="sqrt", inputs={"X": [maxed.name]}, outputs={"Out": [rsq.name]})
+    out = helper.create_tmp_variable(x.dtype, list(x.shape))
+    helper.append_op(
+        type="elementwise_div", inputs={"X": [x.name], "Y": [rsq.name]},
+        outputs={"Out": [out.name]}, attrs={"axis": 0},
+    )
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    k = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * 4 if isinstance(padding, int) else tuple(padding)
+    n, c, h, w = input.shape
+    oh = (h + p[0] + p[2] - k[0]) // s[0] + 1 if h >= 0 else -1
+    ow = (w + p[1] + p[3] - k[1]) // s[1] + 1 if w >= 0 else -1
+    t = oh * ow if oh >= 0 and ow >= 0 else -1
+    out = helper.create_tmp_variable(input.dtype, [n, t, c * k[0] * k[1]], lod_level=1)
+    helper.append_op(
+        type="im2sequence",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"kernels": list(k), "strides": list(s), "paddings": list(p)},
+    )
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None):
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim], dtype=input.dtype)
+    b = helper.create_parameter(
+        ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[num_total_classes],
+        dtype=input.dtype, suffix="b", default_initializer=init_mod.Constant(0.0),
+    )
+    cost = helper.create_tmp_variable(input.dtype, [input.shape[0], 1])
+    sample_logits = helper.create_tmp_variable(input.dtype, [input.shape[0], num_neg_samples + 1], stop_gradient=True)
+    sample_labels = helper.create_tmp_variable("int64", [input.shape[0], num_neg_samples + 1], stop_gradient=True)
+    inputs = {"Input": [input.name], "Label": [label.name], "Weight": [w.name], "Bias": [b.name]}
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight.name]
+    helper.append_op(
+        type="nce",
+        inputs=inputs,
+        outputs={
+            "Cost": [cost.name],
+            "SampleLogits": [sample_logits.name],
+            "SampleLabels": [sample_labels.name],
+        },
+        attrs={
+            "num_neg_samples": num_neg_samples,
+            "num_total_classes": num_total_classes,
+        },
+    )
+    return cost
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None, name=None):
+    helper = LayerHelper("row_conv", act=act, name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        param_attr, shape=[future_context_size + 1, d], dtype=input.dtype
+    )
+    out = helper.create_tmp_variable(input.dtype, list(input.shape), lod_level=input.lod_level)
+    inputs = {"X": [input.name], "Filter": [w.name]}
+    _seq_inputs(inputs, input)
+    helper.append_op(type="row_conv", inputs=inputs, outputs={"Out": [out.name]})
+    _link_length(out, input)
+    return helper.append_activation(out)
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_tmp_variable(inputs[0].dtype, list(inputs[0].shape))
+    helper.append_op(
+        type="multiplex",
+        inputs={"X": inputs, "Ids": [index.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    helper = LayerHelper("linear_chain_crf")
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        param_attr, shape=[num_tags + 2, num_tags], dtype="float32",
+        suffix="transition", default_initializer=init_mod.Uniform(-0.1, 0.1),
+    )
+    b = input.shape[0]
+    ll = helper.create_tmp_variable(input.dtype, [b, 1])
+    emission_exps = helper.create_tmp_variable(input.dtype, list(input.shape), stop_gradient=True)
+    transition_exps = helper.create_tmp_variable("float32", [num_tags + 2, num_tags], stop_gradient=True)
+    alpha = helper.create_tmp_variable(input.dtype, list(input.shape), stop_gradient=True)
+    inputs = {"Emission": [input.name], "Transition": [transition.name], "Label": [label.name]}
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs=inputs,
+        outputs={
+            "LogLikelihood": [ll.name],
+            "EmissionExps": [emission_exps.name],
+            "TransitionExps": [transition_exps.name],
+            "Alpha": [alpha.name],
+        },
+    )
+    return ll
+
+
+def crf_decoding(input, param_attr=None, label=None):
+    helper = LayerHelper("crf_decoding")
+    attr = ParamAttr.to_attr(param_attr)
+    transition = helper.main_program.global_block().var(attr.name)
+    out = helper.create_tmp_variable("int64", list(input.shape[:2]), stop_gradient=True)
+    inputs = {"Emission": [input.name], "Transition": [transition.name]}
+    if label is not None:
+        inputs["Label"] = [label.name]
+    _seq_inputs(inputs, input)
+    helper.append_op(
+        type="crf_decoding", inputs=inputs, outputs={"ViterbiPath": [out.name]}
+    )
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim")
+    out = helper.create_tmp_variable(X.dtype, [X.shape[0], 1])
+    xnorm = helper.create_tmp_variable(X.dtype, [X.shape[0], 1], stop_gradient=True)
+    ynorm = helper.create_tmp_variable(X.dtype, [Y.shape[0], 1], stop_gradient=True)
+    helper.append_op(
+        type="cos_sim",
+        inputs={"X": [X.name], "Y": [Y.name]},
+        outputs={"Out": [out.name], "XNorm": [xnorm.name], "YNorm": [ynorm.name]},
+    )
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_tmp_variable(x.dtype, [1])
+    helper.append_op(type="mean", inputs={"X": [x.name]}, outputs={"Out": [out.name]})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, name=None):
+    helper = LayerHelper("scale", name=name)
+    out = helper.create_tmp_variable(x.dtype, list(x.shape), lod_level=x.lod_level)
+    helper.append_op(
+        type="scale", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+        attrs={"scale": float(scale), "bias": float(bias)},
+    )
+    return _link_length(out, x)
+
+
+def _reduce_layer(op_type):
+    def f(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if dim is None:
+            shape = [1]
+        else:
+            dims = dim if isinstance(dim, (list, tuple)) else [dim]
+            dims = [d % len(input.shape) for d in dims]
+            shape = [
+                (1 if i in dims and keep_dim else s)
+                for i, s in enumerate(input.shape)
+                if keep_dim or i not in dims
+            ] or [1]
+        out = helper.create_tmp_variable(input.dtype, shape)
+        attrs = {"keep_dim": keep_dim, "reduce_all": dim is None}
+        if dim is not None:
+            attrs["dim"] = dim
+        helper.append_op(
+            type=op_type, inputs={"X": [input.name]}, outputs={"Out": [out.name]},
+            attrs=attrs,
+        )
+        return out
+
+    f.__name__ = op_type
+    return f
+
+
+reduce_sum = _reduce_layer("reduce_sum")
+reduce_mean = _reduce_layer("reduce_mean")
+reduce_max = _reduce_layer("reduce_max")
+reduce_min = _reduce_layer("reduce_min")
+reduce_prod = _reduce_layer("reduce_prod")
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_tmp_variable(x.dtype, list(x.shape))
+    helper.append_op(
+        type="clip", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+        attrs={"min": float(min), "max": float(max)},
+    )
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_tmp_variable(x.dtype, list(x.shape))
+    helper.append_op(
+        type="clip_by_norm", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+        attrs={"max_norm": float(max_norm)},
+    )
+    return out
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id):
+    helper = LayerHelper("beam_search")
+    b, k = pre_ids.shape[0], beam_size
+    sel_ids = helper.create_tmp_variable("int64", [b, k], stop_gradient=True)
+    sel_scores = helper.create_tmp_variable("float32", [b, k], stop_gradient=True)
+    parent = helper.create_tmp_variable("int64", [b, k], stop_gradient=True)
+    helper.append_op(
+        type="beam_search",
+        inputs={
+            "PreIds": [pre_ids.name],
+            "PreScores": [pre_scores.name],
+            "Scores": [scores.name],
+        },
+        outputs={
+            "SelectedIds": [sel_ids.name],
+            "SelectedScores": [sel_scores.name],
+            "ParentIdx": [parent.name],
+        },
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return sel_ids, sel_scores, parent
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_tmp_variable(input.dtype, list(input.shape))
+    mid = helper.create_tmp_variable(input.dtype, list(input.shape), stop_gradient=True)
+    helper.append_op(
+        type="lrn", inputs={"X": [input.name]},
+        outputs={"Out": [out.name], "MidOut": [mid.name]},
+        attrs={"n": n, "k": k, "alpha": alpha, "beta": beta},
+    )
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    n, c, h, w = x.shape
+    out = helper.create_tmp_variable(x.dtype, [n, c // groups, h, w])
+    helper.append_op(
+        type="maxout", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+        attrs={"groups": groups},
+    )
+    return out
+
+
+def spp(input, pyramid_height=3, pool_type="max", name=None):
+    helper = LayerHelper("spp", name=name)
+    c = input.shape[1]
+    total = sum((2 ** l) ** 2 for l in range(pyramid_height))
+    out = helper.create_tmp_variable(input.dtype, [input.shape[0], c * total])
+    helper.append_op(
+        type="spp", inputs={"X": [input.name]}, outputs={"Out": [out.name]},
+        attrs={"pyramid_height": pyramid_height, "pooling_type": pool_type},
+    )
+    return out

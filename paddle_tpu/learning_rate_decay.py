@@ -1,0 +1,144 @@
+"""Learning-rate schedules as program state.
+
+Reference: the legacy LearningRateScheduler family
+(paddle/parameter/LearningRateScheduler.cpp: poly/exp/discexp/linear) and
+the pserver-side lr policies (paddle/optimizer/lr_policy.h).  Each schedule
+here maintains a persistable step counter incremented inside the program and
+computes the decayed LR as an ordinary (jitted) op chain; pass the returned
+Variable as an optimizer's learning_rate."""
+
+from .layers.layer_helper import LayerHelper
+from . import initializer as init_mod
+
+__all__ = [
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+]
+
+
+def _global_step(helper):
+    step = helper.create_global_variable(
+        shape=[1], dtype="float32", name=f"{helper.name}.step",
+        initializer=init_mod.Constant(0.0),
+    )
+    helper.append_op(
+        type="increment", inputs={"X": [step.name]}, outputs={"Out": [step.name]},
+        attrs={"step": 1.0},
+    )
+    return step
+
+
+def _tmp(helper):
+    return helper.create_tmp_variable("float32", [1], stop_gradient=True)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr * decay_rate ^ (step / decay_steps)"""
+    helper = LayerHelper("exponential_decay")
+    step = _global_step(helper)
+    div = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [step.name]}, outputs={"Out": [div.name]},
+        attrs={"scale": 1.0 / decay_steps},
+    )
+    if staircase:
+        helper.append_op(type="floor", inputs={"X": [div.name]}, outputs={"Out": [div.name]})
+    base = _tmp(helper)
+    helper.append_op(
+        type="fill_constant", outputs={"Out": [base.name]},
+        attrs={"shape": [1], "dtype": "float32", "value": float(decay_rate)},
+    )
+    powed = _tmp(helper)
+    helper.append_op(
+        type="elementwise_pow", inputs={"X": [base.name], "Y": [div.name]},
+        outputs={"Out": [powed.name]},
+    )
+    lr = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [powed.name]}, outputs={"Out": [lr.name]},
+        attrs={"scale": float(learning_rate)},
+    )
+    return lr
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps)"""
+    helper = LayerHelper("natural_exp_decay")
+    step = _global_step(helper)
+    div = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [step.name]}, outputs={"Out": [div.name]},
+        attrs={"scale": 1.0 / decay_steps},
+    )
+    if staircase:
+        helper.append_op(type="floor", inputs={"X": [div.name]}, outputs={"Out": [div.name]})
+    scaled = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [div.name]}, outputs={"Out": [scaled.name]},
+        attrs={"scale": -float(decay_rate)},
+    )
+    e = _tmp(helper)
+    helper.append_op(type="exp", inputs={"X": [scaled.name]}, outputs={"Out": [e.name]})
+    lr = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [e.name]}, outputs={"Out": [lr.name]},
+        attrs={"scale": float(learning_rate)},
+    )
+    return lr
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps)"""
+    helper = LayerHelper("inverse_time_decay")
+    step = _global_step(helper)
+    div = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [step.name]}, outputs={"Out": [div.name]},
+        attrs={"scale": float(decay_rate) / decay_steps},
+    )
+    if staircase:
+        helper.append_op(type="floor", inputs={"X": [div.name]}, outputs={"Out": [div.name]})
+    denom = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [div.name]}, outputs={"Out": [denom.name]},
+        attrs={"scale": 1.0, "bias": 1.0},
+    )
+    recip = _tmp(helper)
+    helper.append_op(type="reciprocal", inputs={"X": [denom.name]}, outputs={"Out": [recip.name]})
+    lr = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [recip.name]}, outputs={"Out": [lr.name]},
+        attrs={"scale": float(learning_rate)},
+    )
+    return lr
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    """(lr - end_lr) * (1 - min(step, decay_steps)/decay_steps)^power + end_lr"""
+    helper = LayerHelper("polynomial_decay")
+    step = _global_step(helper)
+    capped = _tmp(helper)
+    helper.append_op(
+        type="clip", inputs={"X": [step.name]}, outputs={"Out": [capped.name]},
+        attrs={"min": 0.0, "max": float(decay_steps)},
+    )
+    frac = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [capped.name]}, outputs={"Out": [frac.name]},
+        attrs={"scale": -1.0 / decay_steps, "bias": 1.0},
+    )
+    powed = _tmp(helper)
+    helper.append_op(
+        type="pow", inputs={"X": [frac.name]}, outputs={"Out": [powed.name]},
+        attrs={"factor": float(power)},
+    )
+    lr = _tmp(helper)
+    helper.append_op(
+        type="scale", inputs={"X": [powed.name]}, outputs={"Out": [lr.name]},
+        attrs={"scale": float(learning_rate - end_learning_rate),
+               "bias": float(end_learning_rate)},
+    )
+    return lr

@@ -1,0 +1,74 @@
+"""Host-side composable metrics (the v2 ``event_handler`` statistics helpers
++ the evaluators-as-ops pattern, SURVEY §5 observability).  These accumulate
+on the host from fetched values; the in-program accumulating evaluators live
+in paddle_tpu.evaluator."""
+
+import numpy as np
+
+
+class MetricBase:
+    def __init__(self, name):
+        self._name = name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name="accuracy"):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1.0):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        return self.value / max(self.weight, 1e-12)
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name="edit_distance"):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+
+    def update(self, distances, seq_num):
+        self.total_distance += float(np.sum(distances))
+        self.seq_num += int(seq_num)
+
+    def eval(self):
+        return self.total_distance / max(self.seq_num, 1)
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name="composite"):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, **kwargs):
+        for m in self._metrics:
+            m.update(**kwargs)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

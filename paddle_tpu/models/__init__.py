@@ -1,0 +1,21 @@
+"""Model zoo — the reference "book" chapters + benchmark configs rebuilt on
+the paddle_tpu layers DSL (reference: fluid/tests/book/*,
+benchmark/paddle/image/*.py, benchmark/paddle/rnn/rnn.py)."""
+
+from . import lenet
+from . import resnet
+from . import vgg
+from . import text_classification
+from . import seq2seq
+from . import deep_speech2
+from . import ctr_dnn
+from . import word2vec
+from . import fit_a_line
+from . import label_semantic_roles
+from . import recommender
+
+__all__ = [
+    "lenet", "resnet", "vgg", "text_classification", "seq2seq",
+    "deep_speech2", "ctr_dnn", "word2vec", "fit_a_line",
+    "label_semantic_roles", "recommender",
+]

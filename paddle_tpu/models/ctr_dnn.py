@@ -1,0 +1,35 @@
+"""CTR-DNN with large sparse embeddings (BASELINE config 5 — the go/pserver
+workload: sparse embedding lookups + dense DNN tower, trained via the
+distributed pserver path for cross-host sparse updates)."""
+
+from .. import layers, optimizer as opt
+
+
+def build(sparse_feature_dim=100000, num_slots=8, embedding_size=16,
+          dense_dim=13, hidden=(64, 32), learning_rate=1e-3,
+          is_sparse=True):
+    dense = layers.data("dense_feature", shape=[dense_dim], dtype="float32")
+    slots = [
+        layers.data(f"slot_{i}", shape=[1], dtype="int64")
+        for i in range(num_slots)
+    ]
+    label = layers.data("click", shape=[1], dtype="int64")
+    embs = [
+        layers.embedding(
+            input=s, size=[sparse_feature_dim, embedding_size],
+            is_sparse=is_sparse,
+        )
+        for s in slots
+    ]
+    concat = layers.concat(input=[dense] + embs, axis=1)
+    x = concat
+    for h in hidden:
+        x = layers.fc(input=x, size=h, act="relu")
+    predict = layers.fc(input=x, size=2, act="softmax")
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    auc = layers.auc(input=predict, label=label)
+    optimizer = opt.Adam(learning_rate=learning_rate)
+    optimizer.minimize(avg_cost)
+    return {"feed": [dense] + slots + [label], "prediction": predict,
+            "avg_cost": avg_cost, "auc": auc}

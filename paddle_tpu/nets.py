@@ -1,0 +1,117 @@
+"""Composite networks (reference: fluid/nets.py — simple_img_conv_pool,
+img_conv_group, sequence_conv_pool, glu, scaled_dot_product_attention; plus
+v2 networks.py simple_attention)."""
+
+from . import layers
+
+__all__ = [
+    "simple_img_conv_pool",
+    "img_conv_group",
+    "sequence_conv_pool",
+    "glu",
+    "scaled_dot_product_attention",
+    "simple_attention",
+]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, pool_type="max", param_attr=None):
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, act=act,
+    )
+    return layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride,
+    )
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max"):
+    tmp = input
+    if not isinstance(conv_padding, (list, tuple)):
+        conv_padding = [conv_padding] * len(conv_num_filter)
+    if not isinstance(conv_with_batchnorm, (list, tuple)):
+        conv_with_batchnorm = [conv_with_batchnorm] * len(conv_num_filter)
+    if not isinstance(conv_batchnorm_drop_rate, (list, tuple)):
+        conv_batchnorm_drop_rate = [conv_batchnorm_drop_rate] * len(conv_num_filter)
+    for i, nf in enumerate(conv_num_filter):
+        local_act = None if conv_with_batchnorm[i] else conv_act
+        tmp = layers.conv2d(
+            input=tmp, num_filters=nf, filter_size=conv_filter_size,
+            padding=conv_padding[i], param_attr=param_attr, act=local_act,
+        )
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            if conv_batchnorm_drop_rate[i]:
+                tmp = layers.dropout(x=tmp, dropout_prob=conv_batchnorm_drop_rate[i])
+    return layers.pool2d(
+        input=tmp, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride,
+    )
+
+
+def sequence_conv_pool(input, num_filters, filter_size, act="sigmoid",
+                       pool_type="max", param_attr=None):
+    conv_out = layers.sequence_conv(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, act=act,
+    )
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled dot-product attention over dense [b, t, d] tensors
+    (fluid nets.py scaled_dot_product_attention)."""
+    d = queries.shape[-1]
+    scaled_q = layers.scale(queries, scale=float(d) ** -0.5)
+    product = layers.matmul(scaled_q, keys, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    return layers.matmul(weights, values)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     decoder_size):
+    """Bahdanau-style additive attention over a padded sequence batch
+    (reference: v2 trainer_config_helpers/networks.py simple_attention).
+    encoded_sequence [b, t, d_enc], encoded_proj [b, t, d_dec],
+    decoder_state [b, d_dec] -> context [b, d_enc]."""
+    decoder_state_proj = layers.fc(
+        input=decoder_state, size=decoder_size, bias_attr=False
+    )
+    # broadcast decoder state over time and combine with projected encoder
+    expanded = layers.sequence_expand(x=decoder_state_proj, y=encoded_proj)
+    combined = layers.elementwise_add(encoded_proj, expanded)
+    combined = layers.tanh(combined)
+    # attention energies [b, t, 1] -> weights via masked softmax
+    attention_weights = layers.fc(
+        input=combined, size=1, num_flatten_dims=2, bias_attr=False
+    )
+    attention_weights = layers.reshape(
+        attention_weights, [attention_weights.shape[0], attention_weights.shape[1]]
+    )
+    attention_weights.lod_level = encoded_sequence.lod_level
+    if encoded_sequence.lod_level > 0:
+        attention_weights.block.vars.setdefault(
+            attention_weights.name + "@LENGTH", encoded_sequence.length_var()
+        )
+    attention_weights = layers.sequence_softmax(attention_weights)
+    scaled = layers.elementwise_mul(
+        encoded_sequence, attention_weights, axis=0
+    )
+    scaled.lod_level = encoded_sequence.lod_level
+    if encoded_sequence.lod_level > 0:
+        scaled.block.vars.setdefault(
+            scaled.name + "@LENGTH", encoded_sequence.length_var()
+        )
+    return layers.sequence_pool(scaled, pool_type="sum")

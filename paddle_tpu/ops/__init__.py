@@ -1,0 +1,27 @@
+"""The operator library — pure JAX implementations, one per reference op.
+
+Grouped exactly as the reference groups ``paddle/operators/`` (~150 ops, see
+SURVEY.md §2.2).  Every function here is trace-time code: it runs once under
+``jax.jit`` tracing and returns jax arrays; XLA does the fusing, tiling and
+device placement that the reference implements by hand in CUDA kernels and
+``paddle/operators/math/`` functors.
+"""
+
+from ..core.registry import registered_ops, get_op_impl
+
+from . import math_ops
+from . import activation_ops
+from . import tensor_ops
+from . import random_ops
+from . import nn_ops
+from . import loss_ops
+from . import sequence_ops
+from . import rnn_ops
+from . import optimizer_ops
+from . import control_flow_ops
+from . import beam_search_ops
+from . import metric_ops
+from . import detection_ops
+from . import ctc_ops
+from . import crf_ops
+from . import io_ops

@@ -1,0 +1,111 @@
+"""Activation ops.
+
+Reference: ``paddle/operators/activation_op.{cc,cu}`` — ~20 activations via
+functor templates, each with a hand-written gradient functor.  Here each is
+one jnp expression; gradients come from JAX AD and XLA fuses them into
+neighbouring matmuls (the reference needed separate kernel launches).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _simple(name, fn):
+    @register_op(name)
+    def _op(X, **attrs):
+        return {"Out": fn(X, **{k: v for k, v in attrs.items() if not k.startswith("_")})}
+
+    _op.__name__ = name
+    return _op
+
+
+_simple("sigmoid", lambda X: jax.nn.sigmoid(X))
+_simple("logsigmoid", lambda X: jax.nn.log_sigmoid(X))
+_simple("exp", lambda X: jnp.exp(X))
+_simple("relu", lambda X: jax.nn.relu(X))
+_simple("tanh", lambda X: jnp.tanh(X))
+_simple("tanh_shrink", lambda X: X - jnp.tanh(X))
+_simple("sqrt", lambda X: jnp.sqrt(X))
+_simple("abs", lambda X: jnp.abs(X))
+_simple("ceil", lambda X: jnp.ceil(X))
+_simple("floor", lambda X: jnp.floor(X))
+_simple("round", lambda X: jnp.round(X))
+_simple("reciprocal", lambda X: 1.0 / X)
+_simple("log", lambda X: jnp.log(X))
+_simple("square", lambda X: jnp.square(X))
+_simple("softplus", lambda X: jax.nn.softplus(X))
+_simple("softsign", lambda X: X / (1 + jnp.abs(X)))
+
+
+@register_op("brelu")
+def brelu(X, t_min=0.0, t_max=24.0, **_):
+    return {"Out": jnp.clip(X, t_min, t_max)}
+
+
+@register_op("leaky_relu")
+def leaky_relu(X, alpha=0.02, **_):
+    return {"Out": jnp.where(X > 0, X, alpha * X)}
+
+
+@register_op("soft_relu")
+def soft_relu(X, threshold=40.0, **_):
+    t = jnp.clip(X, -threshold, threshold)
+    return {"Out": jnp.log1p(jnp.exp(t))}
+
+
+@register_op("elu")
+def elu(X, alpha=1.0, **_):
+    return {"Out": jax.nn.elu(X, alpha)}
+
+
+@register_op("relu6")
+def relu6(X, threshold=6.0, **_):
+    return {"Out": jnp.clip(X, 0.0, threshold)}
+
+
+@register_op("pow")
+def pow_op(X, factor=1.0, **_):
+    return {"Out": jnp.power(X, factor)}
+
+
+@register_op("stanh")
+def stanh(X, scale_a=2.0 / 3.0, scale_b=1.7159, **_):
+    return {"Out": scale_b * jnp.tanh(scale_a * X)}
+
+
+@register_op("hard_shrink")
+def hard_shrink(X, threshold=0.5, **_):
+    return {"Out": jnp.where(jnp.abs(X) > threshold, X, 0.0)}
+
+
+@register_op("softshrink")
+def softshrink(X, lambda_=0.5, **attrs):
+    lam = attrs.get("lambda", lambda_)
+    return {"Out": jnp.where(X > lam, X - lam, jnp.where(X < -lam, X + lam, 0.0))}
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(X, threshold=1.0, **_):
+    return {"Out": jnp.where(X > threshold, X, 0.0)}
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(X, slope=0.2, offset=0.5, **_):
+    return {"Out": jnp.clip(slope * X + offset, 0.0, 1.0)}
+
+
+@register_op("swish")
+def swish(X, beta=1.0, **_):
+    return {"Out": X * jax.nn.sigmoid(beta * X)}
+
+
+@register_op("softmax")
+def softmax(X, **_):
+    return {"Out": jax.nn.softmax(X, axis=-1)}
+
+
+@register_op("log_softmax")
+def log_softmax(X, **_):
+    return {"Out": jax.nn.log_softmax(X, axis=-1)}

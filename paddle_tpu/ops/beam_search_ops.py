@@ -1,0 +1,77 @@
+"""Beam search ops.
+
+Reference: ``beam_search_op.cc`` / ``beam_search_decode_op.cc`` operate on
+LoD beams with *dynamic* widths (finished beams shrink the LoD).  Dynamic
+widths don't compile on TPU, so the TPU-native design is the standard
+fixed-width masked beam (SURVEY §7 "hard parts"): beams keep constant width
+k, finished hypotheses are frozen by masking (their score stops changing and
+they only expand with end_id).  beam_search_decode backtracks parent
+pointers stored per step — the functional analog of the reference's
+SentenceVector tree walk.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("beam_search", nondiff=True)
+def beam_search(PreIds, PreScores, Scores, beam_size=4, end_id=1, **_):
+    """One beam-search expansion step.
+
+    PreIds [b, k] int64, PreScores [b, k] accumulated log-probs, Scores
+    [b, k, V] step log-probs.  Returns SelectedIds [b, k], SelectedScores
+    [b, k], ParentIdx [b, k] (which source beam each selected hypothesis
+    extends).
+    """
+    b, k, v = Scores.shape
+    finished = PreIds == end_id
+    # finished beams: only continuation is end_id at zero added cost
+    step_scores = jnp.where(
+        finished[..., None],
+        jnp.where(jnp.arange(v)[None, None, :] == end_id, 0.0, -1e38),
+        Scores,
+    )
+    total = PreScores[..., None] + step_scores  # [b, k, V]
+    flat = total.reshape(b, k * v)
+    top_scores, top_idx = jax.lax.top_k(flat, k)
+    parent = (top_idx // v).astype(jnp.int32)
+    ids = (top_idx % v).astype(jnp.int32)
+    return {
+        "SelectedIds": ids,
+        "SelectedScores": top_scores,
+        "ParentIdx": parent,
+    }
+
+
+@register_op("beam_search_decode", nondiff=True)
+def beam_search_decode(Ids, ParentIdx, Scores=None, end_id=1, **_):
+    """Backtrack stored beams into full sequences.
+
+    Ids/ParentIdx [T, b, k] from stacking beam_search outputs per step.
+    Returns SentenceIds [b, k, T] (right side padded with end_id after the
+    first end token) and SentenceScores [b, k] (final accumulated scores).
+    """
+    t, b, k = Ids.shape
+
+    def backtrack(carry, step):
+        beam_idx = carry  # [b, k] which beam at step+1 each final slot maps to
+        ids_t, parent_t = step
+        tok = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        prev = jnp.take_along_axis(parent_t, beam_idx, axis=1)
+        return prev, tok
+
+    init = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (b, k))
+    _, toks = jax.lax.scan(
+        backtrack, init, (Ids[::-1], ParentIdx[::-1])
+    )  # [T, b, k] reversed
+    sent = jnp.transpose(toks[::-1], (1, 2, 0))  # [b, k, T]
+    # freeze everything after the first end_id to end_id
+    is_end = sent == end_id
+    seen_end = jnp.cumsum(is_end.astype(jnp.int32), axis=-1) - is_end.astype(jnp.int32)
+    sent = jnp.where(seen_end > 0, jnp.asarray(end_id, sent.dtype), sent)
+    out = {"SentenceIds": sent}
+    if Scores is not None:
+        out["SentenceScores"] = Scores[-1] if Scores.ndim == 3 else Scores
+    return out

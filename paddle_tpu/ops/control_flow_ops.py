@@ -1,0 +1,236 @@
+"""Control flow — compare/logical ops and block-structured control ops.
+
+Reference: ``while_op.cc``, ``conditional_block_op.cc``, ``compare_op``,
+``logical_op``, the LoDTensorArray op family, ``parallel_do_op.cc``.  The
+reference interprets sub-blocks by re-entering the Executor with STEP_SCOPES
+(executor.cc:118); here sub-blocks lower to ``lax.while_loop`` /
+``lax.cond`` / ``lax.scan`` — traced once, compiled into the same XLA
+computation, with static shapes throughout.  Tensor "arrays" (the
+LoDTensorArray analog) are preallocated [max_len, ...] buffers written with
+``.at[i].set`` — dynamic append is not an XLA concept.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.executor import run_block_ops
+
+
+def _register_cmp(name, fn):
+    @register_op(name)
+    def _op(X, Y, **_):
+        return {"Out": fn(X, Y)}
+
+    _op.__name__ = name
+
+
+_register_cmp("less_than", jnp.less)
+_register_cmp("less_equal", jnp.less_equal)
+_register_cmp("greater_than", jnp.greater)
+_register_cmp("greater_equal", jnp.greater_equal)
+_register_cmp("equal", jnp.equal)
+_register_cmp("not_equal", jnp.not_equal)
+
+
+@register_op("logical_and")
+def logical_and(X, Y, **_):
+    return {"Out": jnp.logical_and(X, Y)}
+
+
+@register_op("logical_or")
+def logical_or(X, Y, **_):
+    return {"Out": jnp.logical_or(X, Y)}
+
+
+@register_op("logical_xor")
+def logical_xor(X, Y, **_):
+    return {"Out": jnp.logical_xor(X, Y)}
+
+
+@register_op("logical_not")
+def logical_not(X, **_):
+    return {"Out": jnp.logical_not(X)}
+
+
+# ---------------------------------------------------------------------------
+# Tensor array ops (LoDTensorArray analog; lod_tensor_array.h,
+# tensor_array_read_write_op.cc) — Array is a preallocated [max_len, ...]
+# buffer; I is a scalar int index.
+# ---------------------------------------------------------------------------
+@register_op("array_write")
+def array_write(X, I, Array, **_):
+    i = jnp.asarray(I).reshape(()).astype(jnp.int32)
+    return {"Out": Array.at[i].set(X)}
+
+
+@register_op("array_read")
+def array_read(Array, I, **_):
+    i = jnp.asarray(I).reshape(()).astype(jnp.int32)
+    return {"Out": jax.lax.dynamic_index_in_dim(Array, i, axis=0, keepdims=False)}
+
+
+@register_op("array_length")
+def array_length(Array, **_):
+    # static capacity; the dynamic "filled" length is tracked by the loop
+    # counter variable in while-programs (max_sequence_len analog).
+    return {"Out": jnp.asarray([Array.shape[0]], dtype=jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# Structured control-flow ops.  Raw lowerings: they receive (ctx, block, op,
+# env) and splice the sub-block in.
+# ---------------------------------------------------------------------------
+def _sub_block_writes(program, block_idx):
+    blk = program.block(block_idx)
+    written = []
+    for op in blk.ops:
+        for n in op.output_names():
+            if n not in written:
+                written.append(n)
+        sub = op.attrs.get("sub_block")
+        if sub is not None:
+            for n in _sub_block_writes(program, sub):
+                if n not in written:
+                    written.append(n)
+    return written
+
+
+@register_op("while", raw=True)
+def while_op(ctx, block, op, env):
+    """Lower a while sub-block to lax.while_loop.
+
+    Carried state = condition var + every var the sub-block writes that
+    already exists in the enclosing env (same contract as the reference
+    while_op's step-scope promotion).  All carried vars must keep their
+    shape/dtype across iterations (XLA requirement — the reference enforced
+    nothing and paid with dynamic reallocation)."""
+    program = ctx.program
+    sub_idx = op.attrs["sub_block"]
+    cond_name = op.inputs["Condition"][0]
+    written = _sub_block_writes(program, sub_idx)
+    carried = [n for n in written if n in env]
+    if cond_name not in carried:
+        carried.insert(0, cond_name)
+    sub_blk = program.block(sub_idx)
+
+    def cond_fun(carry):
+        return jnp.asarray(carry[cond_name]).reshape(()).astype(jnp.bool_)
+
+    def body_fun(carry):
+        local = dict(env)
+        local.update(carry)
+        run_block_ops(ctx, sub_blk, sub_blk.ops, local)
+        return {n: local[n] for n in carried}
+
+    init = {n: env[n] for n in carried}
+    final = jax.lax.while_loop(cond_fun, body_fun, init)
+    env.update(final)
+
+
+@register_op("conditional_block", raw=True)
+def conditional_block(ctx, block, op, env):
+    """lax.cond over a sub-block.  Outputs must be written by the sub-block;
+    the false branch passes through their current env values (which must
+    exist — declare defaults with fill_constant first)."""
+    program = ctx.program
+    sub_idx = op.attrs["sub_block"]
+    cond_name = op.inputs["Cond"][0]
+    out_names = op.outputs.get("Out", [])
+    sub_blk = program.block(sub_idx)
+
+    def true_fn(operands):
+        local = dict(operands)
+        run_block_ops(ctx, sub_blk, sub_blk.ops, local)
+        return tuple(local[n] for n in out_names)
+
+    def false_fn(operands):
+        return tuple(operands[n] for n in out_names)
+
+    pred = jnp.asarray(env[cond_name]).reshape(()).astype(jnp.bool_)
+    operands = dict(env)
+    outs = jax.lax.cond(pred, true_fn, false_fn, operands)
+    env.update(zip(out_names, outs))
+
+
+@register_op("scan_block", raw=True)
+def scan_block(ctx, block, op, env):
+    """Structured dynamic-RNN op (the TPU-native recurrent_op): scan the
+    sub-block over the time axis of the sequence inputs.
+
+    inputs:  X (list: sequence tensors [b, t, ...] scanned per step as
+             [b, ...]), Init (list: loop-carried states)
+    outputs: Out (list: per-step stacked outputs [b, t, ...]),
+             FinalStates (list: final carried states)
+    attrs:   sub_block, x_names (names the per-step slices take inside the
+             sub-block), state_names (carried var names, updated by the
+             block writing the same name), out_names (per-step outputs to
+             stack), reverse (bool).
+    """
+    program = ctx.program
+    sub_blk = program.block(op.attrs["sub_block"])
+    x_outer = op.inputs.get("X", [])
+    init_outer = op.inputs.get("Init", [])
+    x_names = op.attrs.get("x_names", [])
+    state_names = op.attrs.get("state_names", [])
+    out_names = op.attrs.get("out_names", [])
+    reverse = op.attrs.get("reverse", False)
+
+    xs = {inner: jnp.swapaxes(env[outer], 0, 1) for inner, outer in zip(x_names, x_outer)}
+    if reverse:
+        xs = {k: v[::-1] for k, v in xs.items()}
+    init = {n: env[o] for n, o in zip(state_names, init_outer)}
+
+    def step(carry, x_slice):
+        local = dict(env)
+        local.update(carry)
+        local.update(x_slice)
+        run_block_ops(ctx, sub_blk, sub_blk.ops, local)
+        new_carry = {n: local[n] for n in state_names}
+        ys = tuple(local[n] for n in out_names)
+        return new_carry, ys
+
+    final, stacked = jax.lax.scan(step, init, xs)
+    outs = []
+    for y in stacked:
+        y = jnp.swapaxes(y, 0, 1)
+        outs.append(y[:, ::-1] if reverse else y)
+    if "Out" in op.outputs:
+        for name, val in zip(op.outputs["Out"], outs):
+            env[name] = val
+    if "FinalStates" in op.outputs:
+        for name, sname in zip(op.outputs["FinalStates"], state_names):
+            env[name] = final[sname]
+
+
+@register_op("parallel_do", raw=True)
+def parallel_do(ctx, block, op, env):
+    """Reference parallel_do_op.cc scattered inputs over PLACE_LIST with a
+    thread pool and summed grads.  On TPU data parallelism is mesh sharding
+    (paddle_tpu.parallel) — XLA partitions the *same* program.  This op
+    therefore lowers to plain inline execution of its sub-block; the batch
+    dimension's sharding annotation does the parallel part."""
+    program = ctx.program
+    sub_blk = program.block(op.attrs["sub_block"])
+    run_block_ops(ctx, sub_blk, sub_blk.ops, env)
+
+
+@register_op("feed", raw=True)
+def feed(ctx, block, op, env):
+    pass  # feeds are jit arguments; nothing to do
+
+
+@register_op("fetch", raw=True)
+def fetch(ctx, block, op, env):
+    pass  # fetches are jit outputs
+
+
+@register_op("print", raw=True)
+def print_op(ctx, block, op, env):
+    """FLAGS-controlled debug print (print_op.cc) via jax.debug.print —
+    works inside compiled programs, unlike the reference's host-side loop."""
+    name = op.inputs["In"][0]
+    msg = op.attrs.get("message", "")
+    jax.debug.print(msg + " {name} = {x}", name=name, x=env[name])
+    if "Out" in op.outputs:
+        env[op.outputs["Out"][0]] = env[name]

@@ -1,0 +1,110 @@
+"""Linear-chain CRF ops.
+
+Reference: ``linear_chain_crf_op.{h,cc}`` (forward algorithm + analytic
+gradient) and ``crf_decoding_op`` (Viterbi).  Transition parameter layout
+follows the reference: [num_tags + 2, num_tags] where row 0 = start weights,
+row 1 = end weights, rows 2.. = transition[i][j] from tag i to tag j.
+Padded dense [b, T, num_tags] emissions + lengths replace LoD; both
+recursions are ``lax.scan``s and the log-likelihood gradient comes from AD.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _mask(Length, t):
+    return jnp.arange(t)[None, :] < Length[:, None]
+
+
+def crf_log_norm(emission, transition, lengths):
+    b, t, n = emission.shape
+    start, end, trans = transition[0], transition[1], transition[2:]
+    alpha0 = start[None, :] + emission[:, 0, :]
+
+    def step(alpha, tt):
+        # logsumexp over previous tag
+        scores = alpha[:, :, None] + trans[None, :, :] + emission[:, tt, None, :]
+        new_alpha = jax.scipy.special.logsumexp(scores, axis=1)
+        active = (tt < lengths)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t))
+    return jax.scipy.special.logsumexp(alpha + end[None, :], axis=1)
+
+
+def crf_path_score(emission, transition, labels, lengths):
+    b, t, n = emission.shape
+    start, end, trans = transition[0], transition[1], transition[2:]
+    lbl = labels.astype(jnp.int32)
+    m = _mask(lengths, t).astype(jnp.float32)
+    emit = jnp.take_along_axis(emission, lbl[..., None], axis=2).reshape(b, t)
+    score = start[lbl[:, 0]] + emit[:, 0]
+    tr = trans[lbl[:, :-1], lbl[:, 1:]]  # [b, t-1]
+    score = score + jnp.sum((tr + emit[:, 1:]) * m[:, 1:], axis=1)
+    last = jnp.maximum(lengths.astype(jnp.int32) - 1, 0)
+    last_lbl = jnp.take_along_axis(lbl, last[:, None], axis=1).reshape(-1)
+    return score + end[last_lbl]
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(Emission, Transition, Label, Length=None, **_):
+    b, t, n = Emission.shape
+    lengths = (
+        Length.astype(jnp.int32) if Length is not None else jnp.full((b,), t, jnp.int32)
+    )
+    lbl = Label.reshape(b, t) if Label.ndim == 3 else Label
+    em = Emission.astype(jnp.float32)
+    tr = Transition.astype(jnp.float32)
+    log_z = crf_log_norm(em, tr, lengths)
+    gold = crf_path_score(em, tr, lbl, lengths)
+    nll = log_z - gold
+    return {
+        "LogLikelihood": nll[:, None].astype(Emission.dtype),
+        "EmissionExps": jnp.exp(em - jnp.max(em, axis=-1, keepdims=True)),
+        "TransitionExps": jnp.exp(tr - jnp.max(tr)),
+        "Alpha": jnp.zeros_like(em),
+    }
+
+
+@register_op("crf_decoding", nondiff=True)
+def crf_decoding(Emission, Transition, Label=None, Length=None, **_):
+    """Viterbi decode.  With Label given, outputs per-token correctness mask
+    (reference semantics for evaluation)."""
+    b, t, n = Emission.shape
+    lengths = (
+        Length.astype(jnp.int32) if Length is not None else jnp.full((b,), t, jnp.int32)
+    )
+    em = Emission.astype(jnp.float32)
+    start, end, trans = Transition[0], Transition[1], Transition[2:]
+    delta0 = start[None, :] + em[:, 0, :]
+
+    def fwd(delta, tt):
+        scores = delta[:, :, None] + trans[None, :, :] + em[:, tt, None, :]
+        best_prev = jnp.argmax(scores, axis=1)
+        new_delta = jnp.max(scores, axis=1)
+        active = (tt < lengths)[:, None]
+        delta = jnp.where(active, new_delta, delta)
+        return delta, jnp.where(active, best_prev, jnp.broadcast_to(jnp.arange(n)[None, :], (b, n)))
+
+    delta, backptrs = jax.lax.scan(fwd, delta0, jnp.arange(1, t))  # backptrs [t-1, b, n]
+    final = delta + end[None, :]
+    last_tag = jnp.argmax(final, axis=1)  # [b]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1).reshape(-1)
+        return prev, tag
+
+    # scan emits the tag at t=T-1 first and carries the predecessor; the
+    # final carry is the tag at t=0
+    first_tag, tags_rev = jax.lax.scan(back, last_tag, backptrs[::-1])
+    path = jnp.concatenate([first_tag[None, :], tags_rev[::-1]], axis=0).T  # [b, t]
+    path = jnp.where(_mask(lengths, t), path, 0)
+    if Label is not None:
+        lbl = Label.reshape(b, t) if Label.ndim == 3 else Label
+        correct = jnp.logical_and(path == lbl.astype(path.dtype), _mask(lengths, t))
+        return {"ViterbiPath": correct.astype(jnp.int32)}
+    return {"ViterbiPath": path.astype(jnp.int32)}

@@ -1,0 +1,107 @@
+"""CTC loss — the warpctc replacement.
+
+Reference: ``warpctc_op.cc`` dynamically loads Baidu's warp-ctc CUDA library
+(``platform/dynload/warpctc``); gradient computed by the library.  TPU-native
+form: the forward-backward recursion in log space as a ``lax.scan`` over
+time; the gradient falls out of JAX AD through the scan (same asymptotics as
+warpctc's analytic gradient, and XLA fuses the per-step algebra).  Inputs are
+padded dense [b, T, V] logits + [b, L] labels with explicit lengths — the
+reference's LoD packing is unnecessary.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def ctc_loss_dense(logits, logit_lengths, labels, label_lengths, blank=0):
+    """Negative log-likelihood per batch row.
+
+    logits [b, T, V] (unnormalized), labels [b, L] int32 (no blanks).
+    Standard alpha recursion over the expanded label sequence
+    (blank, l1, blank, l2, ..., blank) of length 2L+1.
+    """
+    b, t, v = logits.shape
+    l = labels.shape[1]
+    s = 2 * l + 1
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # expanded sequence: even positions blank, odd positions labels
+    exp_labels = jnp.full((b, s), blank, dtype=jnp.int32)
+    exp_labels = exp_labels.at[:, 1::2].set(labels.astype(jnp.int32))
+    # allow skip from s-2 to s when labels differ (standard CTC transition)
+    prev2 = jnp.concatenate(
+        [jnp.full((b, 2), -1, jnp.int32), exp_labels[:, :-2]], axis=1
+    )
+    can_skip = jnp.logical_and(
+        jnp.arange(s)[None, :] % 2 == 1, exp_labels != prev2
+    )
+
+    alpha0 = jnp.full((b, s), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    has_label = label_lengths > 0
+    first_lbl = jnp.take_along_axis(
+        logp[:, 0, :], exp_labels[:, 1:2], axis=1
+    ).reshape(-1)
+    alpha0 = alpha0.at[:, 1].set(jnp.where(has_label, first_lbl, _NEG_INF))
+
+    def logaddexp3(a, b_, c):
+        m = jnp.maximum(jnp.maximum(a, b_), c)
+        m = jnp.maximum(m, _NEG_INF)
+        return m + jnp.log(
+            jnp.exp(a - m) + jnp.exp(b_ - m) + jnp.exp(c - m)
+        )
+
+    def step(alpha, tt):
+        stay = alpha
+        move1 = jnp.concatenate(
+            [jnp.full((b, 1), _NEG_INF), alpha[:, :-1]], axis=1
+        )
+        move2 = jnp.concatenate(
+            [jnp.full((b, 2), _NEG_INF), alpha[:, :-2]], axis=1
+        )
+        move2 = jnp.where(can_skip, move2, _NEG_INF)
+        merged = logaddexp3(stay, move1, move2)
+        emit = jnp.take_along_axis(logp[:, tt, :], exp_labels, axis=1)
+        new_alpha = merged + emit
+        # freeze rows whose time is exhausted
+        active = (tt < logit_lengths)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t))
+    # final: sum of last two valid positions (label_len*2 and label_len*2-1)
+    last = 2 * label_lengths.astype(jnp.int32)
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1).reshape(-1)
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1
+    ).reshape(-1)
+    a_prev = jnp.where(label_lengths > 0, a_prev, _NEG_INF)
+    m = jnp.maximum(a_last, a_prev)
+    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+    return -ll
+
+
+@register_op("warpctc")
+def warpctc(Logits, Label, LogitsLength=None, LabelLength=None,
+            blank=0, norm_by_times=False, **_):
+    b, t, v = Logits.shape
+    logit_len = (
+        LogitsLength.astype(jnp.int32)
+        if LogitsLength is not None
+        else jnp.full((b,), t, jnp.int32)
+    )
+    lbl = Label
+    if lbl.ndim == 3 and lbl.shape[-1] == 1:
+        lbl = lbl.reshape(lbl.shape[:-1])
+    label_len = (
+        LabelLength.astype(jnp.int32)
+        if LabelLength is not None
+        else jnp.full((b,), lbl.shape[1], jnp.int32)
+    )
+    loss = ctc_loss_dense(Logits, logit_len, lbl, label_len, blank)
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_len.astype(jnp.float32), 1.0)
+    return {"Loss": loss[:, None].astype(Logits.dtype)}

@@ -1,0 +1,199 @@
+"""Detection / vision ops (reference group: prior_box_op, iou_similarity_op,
+bipartite_match_op, roi_pool_op, detection_output; plus crop/pad/multiplex in
+tensor_ops).  Fixed-size masked forms of the reference's dynamically-sized
+outputs (XLA static shapes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("prior_box", nondiff=True)
+def prior_box(Input, Image, min_sizes=(), max_sizes=(), aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              step_w=0.0, step_h=0.0, offset=0.5, **_):
+    """SSD prior boxes (prior_box_op.cc).  Returns Boxes [H, W, P, 4] and
+    Variances broadcast to the same shape."""
+    fh, fw = Input.shape[2], Input.shape[3]
+    ih, iw = Image.shape[2], Image.shape[3]
+    sw = step_w or iw / fw
+    sh = step_h or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            bw = ms * np.sqrt(ar) / 2.0
+            bh = ms / np.sqrt(ar) / 2.0
+            boxes.append((bw, bh))
+        # extra prior for sqrt(min*max), reference order: after ar==1
+    for ms, mxs in zip(min_sizes, max_sizes or ()):
+        s = np.sqrt(ms * mxs) / 2.0
+        boxes.append((s, s))
+    p = len(boxes)
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    wh = jnp.asarray(boxes, jnp.float32)  # [P, 2]
+    x1 = (cxg[..., None] - wh[None, None, :, 0]) / iw
+    y1 = (cyg[..., None] - wh[None, None, :, 1]) / ih
+    x2 = (cxg[..., None] + wh[None, None, :, 0]) / iw
+    y2 = (cyg[..., None] + wh[None, None, :, 1]) / ih
+    out = jnp.stack([x1, y1, x2, y2], axis=-1)  # [H, W, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+def _iou(a, b):
+    """a [n,4], b [m,4] -> [n,m] (xmin, ymin, xmax, ymax)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register_op("iou_similarity")
+def iou_similarity(X, Y, **_):
+    return {"Out": _iou(X.reshape(-1, 4), Y.reshape(-1, 4))}
+
+
+@register_op("bipartite_match", nondiff=True)
+def bipartite_match(DistMat, **_):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly pick
+    the global max of the distance matrix, match that row/col pair."""
+    dist = DistMat
+    n, m = dist.shape
+
+    def step(carry, _):
+        d, row_of_col, dist_of_col = carry
+        flat = jnp.argmax(d)
+        r, c = flat // m, flat % m
+        val = d[r, c]
+        ok = val > 0
+        row_of_col = jnp.where(ok, row_of_col.at[c].set(r), row_of_col)
+        dist_of_col = jnp.where(ok, dist_of_col.at[c].set(val), dist_of_col)
+        d = jnp.where(ok, d.at[r, :].set(-1.0).at[:, c].set(-1.0), d)
+        return (d, row_of_col, dist_of_col), None
+
+    init = (dist, jnp.full((m,), -1, jnp.int32), jnp.zeros((m,), dist.dtype))
+    (_, row_of_col, dist_of_col), _ = jax.lax.scan(step, init, None, length=min(n, m))
+    return {
+        "ColToRowMatchIndices": row_of_col[None, :],
+        "ColToRowMatchDist": dist_of_col[None, :],
+    }
+
+
+@register_op("roi_pool")
+def roi_pool(X, ROIs, pooled_height=1, pooled_width=1, spatial_scale=1.0, **_):
+    """ROI max pooling (roi_pool_op.cc).  ROIs [R, 5] = (batch_idx, x1, y1,
+    x2, y2) in input coordinates."""
+    n, c, h, w = X.shape
+    r = ROIs.shape[0]
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = X[bi]  # [c, h, w]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+
+        def cell(ph, pw):
+            hstart = y1 + (ph * rh) // pooled_height
+            hend = y1 + ((ph + 1) * rh + pooled_height - 1) // pooled_height
+            wstart = x1 + (pw * rw) // pooled_width
+            wend = x1 + ((pw + 1) * rw + pooled_width - 1) // pooled_width
+            mask = (
+                (ys[:, None] >= hstart) & (ys[:, None] < jnp.maximum(hend, hstart + 1))
+                & (xs[None, :] >= wstart) & (xs[None, :] < jnp.maximum(wend, wstart + 1))
+            )
+            return jnp.max(jnp.where(mask[None], img, -jnp.inf), axis=(1, 2))
+
+        grid = jnp.stack(
+            [jnp.stack([cell(ph, pw) for pw in range(pooled_width)], -1)
+             for ph in range(pooled_height)],
+            -2,
+        )  # [c, ph, pw]
+        return grid
+
+    out = jax.vmap(one_roi)(ROIs.astype(jnp.float32))
+    return {"Out": out, "Argmax": jnp.zeros_like(out, jnp.int32)}
+
+
+@register_op("detection_output", nondiff=True)
+def detection_output(Loc, Conf, PriorBox, background_label=0,
+                     nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, **_):
+    """SSD decode + per-class NMS, fixed-size masked output
+    [keep_top_k, 6] = (label, score, x1, y1, x2, y2); empty slots label=-1."""
+    # Loc [b, P*4] or [b, P, 4]; Conf [b, P, C]; PriorBox [P, 4] + var [P, 4]
+    prior, var = PriorBox[..., :4], None
+    if PriorBox.ndim == 3:  # [2, P, 4] boxes+variances stacked
+        prior, var = PriorBox[0], PriorBox[1]
+    b = Conf.shape[0]
+    p = prior.shape[0]
+    c = Conf.shape[-1]
+    loc = Loc.reshape(b, p, 4)
+    if var is None:
+        var = jnp.full((p, 4), 0.1, jnp.float32)
+    # decode center-size offsets
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    dcx = var[:, 0] * loc[..., 0] * pw + pcx
+    dcy = var[:, 1] * loc[..., 1] * ph + pcy
+    dw = jnp.exp(var[:, 2] * loc[..., 2]) * pw
+    dh = jnp.exp(var[:, 3] * loc[..., 3]) * ph
+    boxes = jnp.stack(
+        [dcx - dw / 2, dcy - dh / 2, dcx + dw / 2, dcy + dh / 2], axis=-1
+    )  # [b, P, 4]
+
+    def per_image(bx, cf):
+        results = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            scores = cf[:, cls]
+            k = min(nms_top_k, p)
+            top_s, top_i = jax.lax.top_k(scores, k)
+            cand = bx[top_i]
+            iou = _iou(cand, cand)
+
+            def nms_step(keep, i):
+                active = jnp.logical_and(jnp.arange(k) < i, keep)
+                sup = jnp.any(jnp.logical_and(active, iou[i] > nms_threshold))
+                ok = jnp.logical_and(~sup, top_s[i] > score_threshold)
+                return keep.at[i].set(ok), None
+
+            keep, _ = jax.lax.scan(nms_step, jnp.zeros((k,), jnp.bool_), jnp.arange(k))
+            cls_col = jnp.full((k, 1), float(cls))
+            entry = jnp.concatenate([cls_col, top_s[:, None], cand], axis=1)
+            entry = jnp.where(keep[:, None], entry, jnp.full_like(entry, -1.0))
+            results.append(entry)
+        allr = jnp.concatenate(results, axis=0)
+        order = jnp.argsort(-allr[:, 1])
+        allr = allr[order][:keep_top_k]
+        pad = keep_top_k - allr.shape[0]
+        if pad > 0:
+            allr = jnp.concatenate([allr, jnp.full((pad, 6), -1.0)], axis=0)
+        return allr
+
+    out = jax.vmap(per_image)(boxes, Conf)
+    return {"Out": out}

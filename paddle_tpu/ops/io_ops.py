@@ -1,0 +1,28 @@
+"""IO-adjacent ops.
+
+Reference: ``save_op.cc`` / ``load_op.cc`` serialize tensors from inside the
+program; ``feed_op`` / ``fetch_op`` bridge the feed/fetch variables
+(``feed_fetch_method.h``).  Host IO cannot live inside a compiled TPU
+program, so save/load are *host-side* operations on the Scope (see
+``paddle_tpu.io``); the ops below exist for program-parity and raise if a
+program containing them is actually lowered — save_inference_model prunes
+them out, matching the reference's inference_optimize flow.
+"""
+
+from ..core.registry import register_op
+
+
+@register_op("save", raw=True)
+def save(ctx, block, op, env):
+    raise RuntimeError(
+        "save_op cannot run inside a compiled program on TPU; use "
+        "paddle_tpu.io.save_persistables/save_vars (host-side)"
+    )
+
+
+@register_op("load", raw=True)
+def load(ctx, block, op, env):
+    raise RuntimeError(
+        "load_op cannot run inside a compiled program on TPU; use "
+        "paddle_tpu.io.load_persistables (host-side)"
+    )

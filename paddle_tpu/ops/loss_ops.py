@@ -1,0 +1,135 @@
+"""Loss ops (reference group: cross_entropy_op, softmax_with_cross_entropy_op,
+sigmoid_cross_entropy_with_logits_op, smooth_l1_loss_op, hinge/huber/log/rank/
+margin_rank/modified_huber losses, nce_op)."""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _squeeze_label(Label):
+    if Label.ndim >= 2 and Label.shape[-1] == 1:
+        return Label.reshape(Label.shape[:-1])
+    return Label
+
+
+@register_op("cross_entropy")
+def cross_entropy(X, Label, soft_label=False, **_):
+    xf = X.astype(jnp.float32)
+    if soft_label:
+        out = -jnp.sum(Label.astype(jnp.float32) * jnp.log(jnp.maximum(xf, 1e-20)), axis=-1, keepdims=True)
+    else:
+        lbl = _squeeze_label(Label).astype(jnp.int32)
+        picked = jnp.take_along_axis(xf, lbl[..., None], axis=-1)
+        out = -jnp.log(jnp.maximum(picked, 1e-20))
+    return {"Y": out.astype(X.dtype)}
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(Logits, Label, soft_label=False, **_):
+    lf = Logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(lf, axis=-1)
+    if soft_label:
+        loss = -jnp.sum(Label.astype(jnp.float32) * logp, axis=-1, keepdims=True)
+    else:
+        lbl = _squeeze_label(Label).astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, lbl[..., None], axis=-1)
+    return {"Softmax": jnp.exp(logp).astype(Logits.dtype), "Loss": loss.astype(Logits.dtype)}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(X, Label, **_):
+    x = X.astype(jnp.float32)
+    z = Label.astype(jnp.float32)
+    loss = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": loss.astype(X.dtype)}
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(X, Y, InsideWeight=None, OutsideWeight=None, sigma=1.0, **_):
+    s2 = sigma * sigma
+    d = X - Y
+    if InsideWeight is not None:
+        d = d * InsideWeight
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    if OutsideWeight is not None:
+        loss = loss * OutsideWeight
+    out = jnp.sum(loss.reshape(X.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": out, "Diff": d}
+
+
+@register_op("hinge_loss")
+def hinge_loss(Logits, Labels, **_):
+    y = Labels.astype(Logits.dtype) * 2.0 - 1.0
+    return {"Loss": jnp.maximum(1.0 - Logits * y, 0.0)}
+
+
+@register_op("huber_loss")
+def huber_loss(X, Y, delta=1.0, **_):
+    r = Y - X
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("log_loss")
+def log_loss(Predicted, Labels, epsilon=1e-4, **_):
+    p = Predicted
+    l = Labels
+    return {"Loss": -l * jnp.log(p + epsilon) - (1 - l) * jnp.log(1 - p + epsilon)}
+
+
+@register_op("rank_loss")
+def rank_loss(Label, Left, Right, **_):
+    d = Left - Right
+    return {"Out": jnp.log1p(jnp.exp(d)) - Label * d}
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(Label, X1, X2, margin=0.0, **_):
+    out = jnp.maximum(-Label * (X1 - X2) + margin, 0.0)
+    return {"Out": out, "Activated": (out > 0).astype(X1.dtype)}
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(X, Y, **_):
+    # labels in {0,1} -> {-1,1}; modified_huber_loss_op.cc
+    y = Y.astype(X.dtype) * 2.0 - 1.0
+    z = X * y
+    loss = jnp.where(z < -1.0, -4.0 * z, jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("nce", stateful_rng=True)
+def nce(Input, Label, Weight, Bias=None, SampleWeight=None,
+        num_neg_samples=10, num_total_classes=None, _key=None, **_):
+    """Noise-contrastive estimation (nce_op.cc) with uniform negative
+    sampling.  Input [b,d], Weight [classes,d], Label [b,1]."""
+    b = Input.shape[0]
+    total = num_total_classes or Weight.shape[0]
+    lbl = _squeeze_label(Label).astype(jnp.int32)
+    key = _key if _key is not None else jax.random.PRNGKey(0)
+    neg = jax.random.randint(key, (b, num_neg_samples), 0, total)
+
+    def logit(ids):
+        w = Weight[ids]  # [..., d]
+        out = jnp.sum(w * Input[:, None, :] if ids.ndim == 2 else w * Input, axis=-1)
+        if Bias is not None:
+            out = out + Bias[ids]
+        return out
+
+    pos_logit = logit(lbl[:, None])[:, 0]
+    neg_logit = logit(neg)
+    p_noise = 1.0 / total
+    pos_p = jax.nn.sigmoid(pos_logit - jnp.log(num_neg_samples * p_noise))
+    neg_p = jax.nn.sigmoid(neg_logit - jnp.log(num_neg_samples * p_noise))
+    loss = -jnp.log(jnp.maximum(pos_p, 1e-20)) - jnp.sum(
+        jnp.log(jnp.maximum(1 - neg_p, 1e-20)), axis=1
+    )
+    if SampleWeight is not None:
+        loss = loss * SampleWeight.reshape(-1)
+    return {"Cost": loss[:, None],
+            "SampleLogits": jnp.concatenate([pos_logit[:, None], neg_logit], axis=1),
+            "SampleLabels": jnp.concatenate([lbl[:, None], neg], axis=1)}

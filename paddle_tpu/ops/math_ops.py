@@ -1,0 +1,197 @@
+"""GEMM / elementwise / reduction ops.
+
+Reference groups (SURVEY §2.2): ``mul_op``, ``matmul_op`` (cuBLAS via
+``operators/math/math_function``), ``elementwise_*_op`` with the axis
+broadcast rule (``elementwise_op_function.h``), ``reduce_op``, ``sum_op``,
+``scale/sign/clip/cast/minus`` etc.  All become single jnp/lax calls that XLA
+maps straight onto the MXU (dots) and VPU (elementwise) — matmuls
+accumulate in float32 via ``preferred_element_type`` so bfloat16 inputs keep
+MXU-native speed without losing accumulation precision.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from ..core.dtypes import convert_dtype
+
+
+def _acc_type(x):
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+def _broadcast_y(X, Y, axis):
+    """Reference broadcast rule (elementwise_op_function.h): Y's dims align
+    with X's dims starting at ``axis`` (default -1 = align trailing)."""
+    if Y.ndim == 0 or X.shape == Y.shape:
+        return Y
+    ax = axis if axis >= 0 else X.ndim - Y.ndim
+    # trim trailing size-1 dims like the reference does
+    yshape = list(Y.shape)
+    while yshape and yshape[-1] == 1 and len(yshape) > X.ndim - ax:
+        yshape.pop()
+    newshape = [1] * X.ndim
+    newshape[ax : ax + len(yshape)] = yshape
+    return Y.reshape(newshape)
+
+
+def _register_elementwise(name, fn):
+    @register_op("elementwise_" + name)
+    def _op(X, Y, axis=-1, **_):
+        return {"Out": fn(X, _broadcast_y(X, Y, axis))}
+
+    _op.__name__ = "elementwise_" + name
+    return _op
+
+
+_register_elementwise("add", jnp.add)
+_register_elementwise("sub", jnp.subtract)
+_register_elementwise("mul", jnp.multiply)
+_register_elementwise("div", jnp.divide)
+_register_elementwise("max", jnp.maximum)
+_register_elementwise("min", jnp.minimum)
+_register_elementwise("pow", jnp.power)
+
+
+@register_op("mul")
+def mul(X, Y, x_num_col_dims=1, y_num_col_dims=1, **_):
+    """Flattening matmul (reference mul_op.cc): X collapses to 2-D at
+    x_num_col_dims, Y at y_num_col_dims; result regains X's leading dims."""
+    x2 = X.reshape((int(np.prod(X.shape[:x_num_col_dims])), -1))
+    y2 = Y.reshape((int(np.prod(Y.shape[:y_num_col_dims])), -1))
+    out = jnp.dot(x2, y2, preferred_element_type=_acc_type(X))
+    if out.dtype != X.dtype:
+        out = out.astype(X.dtype)
+    out_shape = X.shape[:x_num_col_dims] + Y.shape[y_num_col_dims:]
+    return {"Out": out.reshape(out_shape)}
+
+
+@register_op("matmul")
+def matmul(X, Y, transpose_X=False, transpose_Y=False, alpha=1.0, **_):
+    x = jnp.swapaxes(X, -1, -2) if transpose_X and X.ndim >= 2 else X
+    y = jnp.swapaxes(Y, -1, -2) if transpose_Y and Y.ndim >= 2 else Y
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
+    if out.dtype != X.dtype:
+        out = out.astype(X.dtype)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("sum")
+def sum_op(X, **_):
+    xs = X if isinstance(X, (list, tuple)) else [X]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("scale")
+def scale(X, scale=1.0, bias=0.0, bias_after_scale=True, **_):
+    if bias_after_scale:
+        return {"Out": X * scale + bias}
+    return {"Out": (X + bias) * scale}
+
+
+@register_op("minus")
+def minus(X, Y, **_):
+    return {"Out": X - Y}
+
+
+@register_op("sign")
+def sign(X, **_):
+    return {"Out": jnp.sign(X)}
+
+
+@register_op("clip")
+def clip(X, min=-1.0, max=1.0, **_):
+    return {"Out": jnp.clip(X, min, max)}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(X, max_norm=1.0, **_):
+    norm = jnp.sqrt(jnp.sum(jnp.square(X.astype(jnp.float32))))
+    factor = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": (X * factor.astype(X.dtype))}
+
+
+@register_op("cast")
+def cast(X, out_dtype="float32", **_):
+    return {"Out": X.astype(convert_dtype(out_dtype))}
+
+
+def _reduce(fn, X, dim, keep_dim, reduce_all):
+    if reduce_all or dim is None:
+        axis = None
+    else:
+        axis = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+    return fn(X, axis=axis, keepdims=keep_dim)
+
+
+def _register_reduce(name, fn):
+    @register_op("reduce_" + name)
+    def _op(X, dim=None, keep_dim=False, reduce_all=False, **_):
+        return {"Out": _reduce(fn, X, dim, keep_dim, reduce_all)}
+
+    return _op
+
+
+_register_reduce("sum", jnp.sum)
+_register_reduce("mean", jnp.mean)
+_register_reduce("max", jnp.max)
+_register_reduce("min", jnp.min)
+_register_reduce("prod", jnp.prod)
+
+
+@register_op("mean")
+def mean(X, **_):
+    return {"Out": jnp.mean(X).reshape(1)}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(X, **_):
+    return {"Out": jnp.sum(jnp.square(X)).reshape(1)}
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(X, Y, **_):
+    d = X - _broadcast_y(X, Y, -1)
+    sub = d.reshape((d.shape[0], -1))
+    return {"sub_result": sub, "Out": jnp.sum(jnp.square(sub), axis=1, keepdims=True)}
+
+
+@register_op("cos_sim")
+def cos_sim(X, Y, **_):
+    # Y may have batch 1 (broadcast against all rows of X), cos_sim_op.cc
+    if Y.shape[0] == 1 and X.shape[0] != 1:
+        Y = jnp.broadcast_to(Y, X.shape)
+    xn = jnp.sqrt(jnp.sum(jnp.square(X), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(Y), axis=1, keepdims=True))
+    out = jnp.sum(X * Y, axis=1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("dot")
+def dot(X, Y, **_):
+    return {"Out": jnp.sum(X * Y, axis=-1, keepdims=True)}
+
+
+@register_op("norm")
+def norm(X, Input=None, epsilon=1e-10, **_):
+    # reference norm_op: l2-normalize across channel dim (NCHW dim 1),
+    # optionally scaled by a learnable per-channel Scale input.
+    sq = jnp.sum(jnp.square(X), axis=1, keepdims=True)
+    out = X / jnp.sqrt(sq + epsilon)
+    if Input is not None:
+        out = out * Input.reshape((1, -1) + (1,) * (X.ndim - 2))
+    return {"Out": out}
+
+
+@register_op("maxout")
+def maxout(X, groups=2, **_):
+    n, c, h, w = X.shape
+    return {"Out": jnp.max(X.reshape(n, c // groups, groups, h, w), axis=2)}

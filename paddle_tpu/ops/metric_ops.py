@@ -1,0 +1,183 @@
+"""Metric ops (reference: accuracy_op, auc_op, precision_recall_op,
+positive_negative_pair_op, chunk_eval_op).  Metrics are part of the program
+(SURVEY §5) — accumulator state lives in persistable variables so metric
+updates fuse into the jitted step."""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy", nondiff=True)
+def accuracy(Out, Indices, Label, **_):
+    """Top-k accuracy (accuracy_op.cc): Indices [b, k] from top_k, Label
+    [b, 1]."""
+    lbl = Label.reshape(-1, 1).astype(Indices.dtype)
+    correct = jnp.any(Indices == lbl, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(float(Indices.shape[0]), jnp.float32)
+    return {
+        "Accuracy": (num_correct / total).reshape(1),
+        "Correct": num_correct.astype(jnp.int32).reshape(1),
+        "Total": total.astype(jnp.int32).reshape(1),
+    }
+
+
+@register_op("auc", nondiff=True)
+def auc(Out, Indices=None, Label=None, curve="ROC", num_thresholds=200, **_):
+    """Approximate AUC via threshold buckets (auc_op.cc)."""
+    pos_prob = Out[:, 1] if Out.ndim == 2 and Out.shape[1] >= 2 else Out.reshape(-1)
+    lbl = Label.reshape(-1).astype(jnp.bool_)
+    thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
+    pred_pos = pos_prob[None, :] >= thresholds[:, None]  # [T, b]
+    tp = jnp.sum(jnp.logical_and(pred_pos, lbl[None, :]), axis=1).astype(jnp.float32)
+    fp = jnp.sum(jnp.logical_and(pred_pos, ~lbl[None, :]), axis=1).astype(jnp.float32)
+    pos = jnp.maximum(jnp.sum(lbl.astype(jnp.float32)), 1.0)
+    neg = jnp.maximum(jnp.sum((~lbl).astype(jnp.float32)), 1.0)
+    tpr = tp / pos
+    fpr = fp / neg
+    # integrate (thresholds descend fpr); trapezoid
+    auc_val = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": auc_val.reshape(1)}
+
+
+@register_op("precision_recall", nondiff=True)
+def precision_recall(MaxProbs=None, Indices=None, Labels=None, Weights=None,
+                     StatesInfo=None, class_number=2, **_):
+    """Multi-class precision/recall (precision_recall_op.cc).  Maintains
+    per-class [TP, FP, TN, FN] stats; returns batch + accumulated metrics."""
+    pred = Indices.reshape(-1).astype(jnp.int32)
+    lbl = Labels.reshape(-1).astype(jnp.int32)
+    w = Weights.reshape(-1) if Weights is not None else jnp.ones_like(pred, jnp.float32)
+    classes = jnp.arange(class_number)
+    is_pred = pred[None, :] == classes[:, None]   # [C, b]
+    is_lbl = lbl[None, :] == classes[:, None]
+    tp = jnp.sum(jnp.where(jnp.logical_and(is_pred, is_lbl), w[None, :], 0.0), axis=1)
+    fp = jnp.sum(jnp.where(jnp.logical_and(is_pred, ~is_lbl), w[None, :], 0.0), axis=1)
+    fn = jnp.sum(jnp.where(jnp.logical_and(~is_pred, is_lbl), w[None, :], 0.0), axis=1)
+    tn = jnp.sum(jnp.where(jnp.logical_and(~is_pred, ~is_lbl), w[None, :], 0.0), axis=1)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+    acc_states = batch_states + (StatesInfo if StatesInfo is not None else 0.0)
+
+    def metrics(states):
+        tp_, fp_, tn_, fn_ = states[:, 0], states[:, 1], states[:, 2], states[:, 3]
+        prec = tp_ / jnp.maximum(tp_ + fp_, 1e-12)
+        rec = tp_ / jnp.maximum(tp_ + fn_, 1e-12)
+        f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+        # macro + micro averaged, as the reference outputs 6 numbers
+        micro_p = jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fp_), 1e-12)
+        micro_r = jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fn_), 1e-12)
+        micro_f1 = 2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-12)
+        return jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1),
+                          micro_p, micro_r, micro_f1])
+
+    return {
+        "BatchMetrics": metrics(batch_states),
+        "AccumMetrics": metrics(acc_states),
+        "AccumStatesInfo": acc_states,
+    }
+
+
+@register_op("positive_negative_pair", nondiff=True)
+def positive_negative_pair(Score, Label, QueryID, AccumulatePositivePair=None,
+                           AccumulateNegativePair=None, AccumulateNeutralPair=None, **_):
+    """Ranking pair stats within each query (positive_negative_pair_op.cc)."""
+    s = Score.reshape(-1)
+    l = Label.reshape(-1).astype(jnp.float32)
+    q = QueryID.reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1)
+    valid = jnp.logical_and(same_q, upper > 0)
+    ds = s[:, None] - s[None, :]
+    dl = l[:, None] - l[None, :]
+    informative = jnp.logical_and(valid, dl != 0)
+    pos = jnp.sum(jnp.where(jnp.logical_and(informative, ds * dl > 0), 1.0, 0.0))
+    neg = jnp.sum(jnp.where(jnp.logical_and(informative, ds * dl < 0), 1.0, 0.0))
+    neu = jnp.sum(jnp.where(jnp.logical_and(informative, ds == 0), 1.0, 0.0))
+    if AccumulatePositivePair is not None:
+        pos = pos + AccumulatePositivePair.reshape(())
+        neg = neg + AccumulateNegativePair.reshape(())
+        neu = neu + AccumulateNeutralPair.reshape(())
+    return {
+        "PositivePair": pos.reshape(1),
+        "NegativePair": neg.reshape(1),
+        "NeutralPair": neu.reshape(1),
+    }
+
+
+@register_op("chunk_eval", nondiff=True)
+def chunk_eval(Inference, Label, Length=None, num_chunk_types=1,
+               chunk_scheme="IOB", **_):
+    """Chunk-level precision/recall/F1 (chunk_eval_op.cc), IOB scheme.
+
+    Tag encoding follows the reference: for IOB, tag = chunk_type * 2
+    (B-) or chunk_type * 2 + 1 (I-); the "outside" tag is num_chunk_types*2.
+    A chunk match requires identical (begin, end, type) spans.
+    """
+    if chunk_scheme != "IOB":
+        raise NotImplementedError("only IOB chunk_scheme is implemented")
+    b, t = Inference.shape
+    mask = (
+        (jnp.arange(t)[None, :] < Length[:, None])
+        if Length is not None
+        else jnp.ones((b, t), jnp.bool_)
+    )
+
+    def spans(tags):
+        """begin[i]: a chunk starts at i; type[i]: its chunk type."""
+        outside = num_chunk_types * 2
+        valid = jnp.logical_and(tags < outside, mask)
+        is_b = jnp.logical_and(valid, tags % 2 == 0)
+        is_i = jnp.logical_and(valid, tags % 2 == 1)
+        ctype = tags // 2
+        prev_valid = jnp.concatenate([jnp.zeros((b, 1), jnp.bool_), valid[:, :-1]], axis=1)
+        prev_type = jnp.concatenate([jnp.full((b, 1), -1, ctype.dtype), ctype[:, :-1]], axis=1)
+        # I- starts a chunk if previous token wasn't inside same-type chunk
+        starts = jnp.logical_or(
+            is_b, jnp.logical_and(is_i, jnp.logical_or(~prev_valid, prev_type != ctype))
+        )
+        nxt_valid = jnp.concatenate([valid[:, 1:], jnp.zeros((b, 1), jnp.bool_)], axis=1)
+        nxt_type = jnp.concatenate([ctype[:, 1:], jnp.full((b, 1), -1, ctype.dtype)], axis=1)
+        nxt_tags = jnp.concatenate([tags[:, 1:], jnp.full((b, 1), outside, tags.dtype)], axis=1)
+        # chunk ends at i if next token is not an I- of same type
+        cont = jnp.logical_and(
+            jnp.logical_and(nxt_valid, nxt_tags % 2 == 1), nxt_type == ctype
+        )
+        ends = jnp.logical_and(valid, ~cont)
+        return starts, ends, ctype, valid
+
+    inf_s, inf_e, inf_t, inf_v = spans(Inference.astype(jnp.int32))
+    lab_s, lab_e, lab_t, lab_v = spans(Label.astype(jnp.int32))
+
+    # identify chunks by their start index; a chunk is (start, end, type).
+    # end index for a chunk starting at i = next end position >= i.
+    idx = jnp.arange(t)[None, :]
+
+    def chunk_end(ends):
+        # for each position, the nearest end at or after it
+        INF = t + 1
+        end_pos = jnp.where(ends, idx, INF)
+        rev_cummin = jnp.flip(jax.lax.cummin(jnp.flip(end_pos, axis=1), axis=1), axis=1)
+        return rev_cummin
+
+    inf_end = chunk_end(inf_e)
+    lab_end = chunk_end(lab_e)
+    num_inf = jnp.sum(jnp.where(inf_s, 1.0, 0.0))
+    num_lab = jnp.sum(jnp.where(lab_s, 1.0, 0.0))
+    match = jnp.logical_and(
+        jnp.logical_and(inf_s, lab_s),
+        jnp.logical_and(inf_end == lab_end, inf_t == lab_t),
+    )
+    num_correct = jnp.sum(jnp.where(match, 1.0, 0.0))
+    precision = num_correct / jnp.maximum(num_inf, 1e-12)
+    recall = num_correct / jnp.maximum(num_lab, 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return {
+        "Precision": precision.reshape(1),
+        "Recall": recall.reshape(1),
+        "F1-Score": f1.reshape(1),
+        "NumInferChunks": num_inf.astype(jnp.int32).reshape(1),
+        "NumLabelChunks": num_lab.astype(jnp.int32).reshape(1),
+        "NumCorrectChunks": num_correct.astype(jnp.int32).reshape(1),
+    }

@@ -1,0 +1,304 @@
+"""Conv / pool / normalization ops.
+
+Reference: ``conv_op`` (im2col+gemm, ``conv_cudnn_op.cu.cc``), ``pool_op``,
+``batch_norm_op`` (+cudnn), ``lrn_op``, ``spp_op``, ``unpool_op``,
+``row_conv_op`` (DeepSpeech lookahead), ``im2sequence_op``.  On TPU a conv is
+one ``lax.conv_general_dilated`` — XLA tiles it onto the MXU directly; the
+whole im2col/cuDNN-algorithm-selection machinery disappears.  Layout stays
+NCHW at the API (reference convention); XLA relayouts internally as needed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+_CONV_DN = ("NCHW", "OIHW", "NCHW")
+
+
+def _acc(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+@register_op("conv2d")
+def conv2d(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(1, 1), groups=1, **_):
+    s, p, d = _pair(strides), _pair(paddings), _pair(dilations)
+    out = jax.lax.conv_general_dilated(
+        Input,
+        Filter.astype(Input.dtype),
+        window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d,
+        dimension_numbers=_CONV_DN,
+        feature_group_count=groups,
+        preferred_element_type=_acc(Input),
+    )
+    return {"Output": out.astype(Input.dtype)}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(1, 1), groups=None, **_):
+    g = groups or Input.shape[1]
+    return conv2d(
+        Input=Input, Filter=Filter, strides=strides, paddings=paddings,
+        dilations=dilations, groups=g,
+    )
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(1, 1), **_):
+    """Fractionally-strided conv: lhs_dilation by stride + spatially-flipped
+    kernel, the gradient-of-conv formulation (reference
+    conv_transpose_op.cc).  Filter layout is (C_in, C_out, H, W)."""
+    s, p, d = _pair(strides), _pair(paddings), _pair(dilations)
+    w = jnp.swapaxes(Filter.astype(Input.dtype), 0, 1)[:, :, ::-1, ::-1]
+    kh, kw = w.shape[2], w.shape[3]
+    pad_h = kh - 1 - p[0]
+    pad_w = kw - 1 - p[1]
+    out = jax.lax.conv_general_dilated(
+        Input,
+        w,
+        window_strides=(1, 1),
+        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        lhs_dilation=s,
+        rhs_dilation=d,
+        dimension_numbers=_CONV_DN,
+        preferred_element_type=_acc(Input),
+    )
+    return {"Output": out.astype(Input.dtype)}
+
+
+@register_op("conv3d")
+def conv3d(Input, Filter, strides=(1, 1, 1), paddings=(0, 0, 0), dilations=(1, 1, 1), groups=1, **_):
+    s, p, d = _pair(strides, 3), _pair(paddings, 3), _pair(dilations, 3)
+    out = jax.lax.conv_general_dilated(
+        Input,
+        Filter.astype(Input.dtype),
+        window_strides=s,
+        padding=[(pp, pp) for pp in p],
+        rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+        preferred_element_type=_acc(Input),
+    )
+    return {"Output": out.astype(Input.dtype)}
+
+
+@register_op("conv_shift")
+def conv_shift(X, Y, **_):
+    """Circular correlation (conv_shift_op.cc): out[i,j] = sum_k x[i, (j+k-M/2) % W] y[i,k]."""
+    b, w = X.shape
+    m = Y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(w)[:, None] + jnp.arange(m)[None, :] - half) % w
+    gathered = X[:, idx]  # [b, w, m]
+    return {"Out": jnp.einsum("bwm,bm->bw", gathered, Y)}
+
+
+def _pool2d(X, ksize, strides, paddings, pooling_type, global_pooling, ceil_mode=False, exclusive=True):
+    k, s, p = _pair(ksize), _pair(strides), _pair(paddings)
+    if global_pooling:
+        k = X.shape[2:]
+        p = (0, 0)
+    window = (1, 1) + tuple(k)
+    stride = (1, 1) + tuple(s)
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ceil_mode:
+        extra = []
+        for i in range(2):
+            size = X.shape[2 + i] + 2 * p[i] - k[i]
+            rem = size % s[i]
+            extra.append((s[i] - rem) % s[i] if rem else 0)
+        pads = ((0, 0), (0, 0), (p[0], p[0] + extra[0]), (p[1], p[1] + extra[1]))
+    # NOTE: init values must be Python scalars so JAX recognizes the monoid
+    # and emits reduce_window_max/_sum primitives (which have linearization
+    # rules); an Array init falls back to generic reduce_window, which
+    # cannot be differentiated under jit.
+    if pooling_type == "max":
+        init = -np.inf if jnp.issubdtype(X.dtype, jnp.floating) else int(jnp.iinfo(X.dtype).min)
+        return jax.lax.reduce_window(X, init, jax.lax.max, window, stride, pads)
+    ones = jnp.ones_like(X)
+    summed = jax.lax.reduce_window(X, 0.0, jax.lax.add, window, stride, pads)
+    if exclusive:
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, stride, pads)
+    else:
+        counts = jnp.asarray(np.prod(k), X.dtype)
+    return summed / counts
+
+
+@register_op("pool2d")
+def pool2d(
+    X,
+    ksize=(2, 2),
+    strides=(1, 1),
+    paddings=(0, 0),
+    pooling_type="max",
+    global_pooling=False,
+    ceil_mode=False,
+    exclusive=True,
+    **_,
+):
+    return {"Out": _pool2d(X, ksize, strides, paddings, pooling_type, global_pooling, ceil_mode, exclusive)}
+
+
+@register_op("max_pool2d_with_index", nondiff=True)
+def max_pool2d_with_index(X, ksize=(2, 2), strides=(1, 1), paddings=(0, 0), global_pooling=False, **_):
+    out = _pool2d(X, ksize, strides, paddings, "max", global_pooling)
+    # indices: flat position within each feature map (reference pool_with_index_op)
+    n, c, h, w = X.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, X.shape)
+    k, s, p = _pair(ksize), _pair(strides), _pair(paddings)
+    if global_pooling:
+        k, p = (h, w), (0, 0)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, idxs = jax.lax.reduce_window(
+        (X, flat_idx),
+        (jnp.asarray(-jnp.inf, X.dtype), jnp.asarray(-1.0, jnp.float32)),
+        sel,
+        (1, 1) + tuple(k),
+        (1, 1) + tuple(s),
+        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+    )
+    return {"Out": vals, "Mask": idxs.astype(jnp.int32)}
+
+
+@register_op("unpool")
+def unpool(X, Indices, ksize=(2, 2), strides=(2, 2), paddings=(0, 0), unpooling_type="max", **_):
+    n, c, h, w = X.shape
+    s = _pair(strides)
+    oh, ow = h * s[0], w * s[1]
+    flat = jnp.zeros((n, c, oh * ow), dtype=X.dtype)
+    idx = Indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = X.reshape(n, c, -1)
+    flat = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return {"Out": flat.reshape(n, c, oh, ow)}
+
+
+@register_op("spp")
+def spp(X, pyramid_height=3, pooling_type="max", **_):
+    """Spatial pyramid pooling (spp_op.cc): concat of pyramid_height levels."""
+    n, c, h, w = X.shape
+    outs = []
+    for lvl in range(pyramid_height):
+        bins = 2 ** lvl
+        kh, kw = int(np.ceil(h / bins)), int(np.ceil(w / bins))
+        sh, sw = kh, kw
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        o = _pool2d(X, (kh, kw), (sh, sw), (ph, pw), pooling_type, False, False, False)
+        outs.append(o.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("batch_norm")
+def batch_norm(
+    X,
+    Scale,
+    Bias,
+    Mean,
+    Variance,
+    momentum=0.9,
+    epsilon=1e-5,
+    is_test=False,
+    data_layout="NCHW",
+    **_,
+):
+    axes = tuple(i for i in range(X.ndim) if i != (1 if data_layout == "NCHW" else X.ndim - 1))
+    cdim = 1 if data_layout == "NCHW" else X.ndim - 1
+    bshape = [1] * X.ndim
+    bshape[cdim] = X.shape[cdim]
+
+    xf = X.astype(jnp.float32)
+    if is_test:
+        mean, var = Mean.astype(jnp.float32), Variance.astype(jnp.float32)
+        mean_out, var_out = Mean, Variance
+        saved_mean, saved_var = Mean, Variance
+    else:
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        mean_out = (momentum * Mean.astype(jnp.float32) + (1 - momentum) * mean).astype(Mean.dtype)
+        var_out = (momentum * Variance.astype(jnp.float32) + (1 - momentum) * var).astype(Variance.dtype)
+        saved_mean, saved_var = mean, var
+    inv = jax.lax.rsqrt(var + epsilon)
+    y = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * Scale.astype(jnp.float32).reshape(bshape) + Bias.astype(jnp.float32).reshape(bshape)
+    return {
+        "Y": y.astype(X.dtype),
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm")
+def layer_norm(X, Scale=None, Bias=None, begin_norm_axis=1, epsilon=1e-5, **_):
+    axes = tuple(range(begin_norm_axis, X.ndim))
+    xf = X.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if Scale is not None:
+        y = y * Scale.astype(jnp.float32)
+    if Bias is not None:
+        y = y + Bias.astype(jnp.float32)
+    return {
+        "Y": y.astype(X.dtype),
+        "Mean": mean.reshape(X.shape[:begin_norm_axis]),
+        "Variance": var.reshape(X.shape[:begin_norm_axis]),
+    }
+
+
+@register_op("lrn")
+def lrn(X, n=5, k=2.0, alpha=1e-4, beta=0.75, **_):
+    sq = jnp.square(X)
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    windows = sum(padded[:, i : i + X.shape[1]] for i in range(n))
+    mid = k + alpha * windows
+    return {"Out": X / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("im2sequence")
+def im2sequence(X, kernels=(1, 1), strides=(1, 1), paddings=(0, 0, 0, 0), **_):
+    """Sliding-window patches → sequence (im2sequence_op.cc).  Output is
+    [N, out_h*out_w, C*kh*kw] padded-dense (the reference emits LoD)."""
+    n, c, h, w = X.shape
+    kh, kw = _pair(kernels)
+    sh, sw = _pair(strides)
+    pu, pl, pd, pr = paddings if len(paddings) == 4 else (paddings[0], paddings[1], paddings[0], paddings[1])
+    xp = jnp.pad(X, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), padding="VALID", dimension_numbers=_CONV_DN
+    )  # [N, C*kh*kw, oh, ow]
+    ckk = patches.shape[1]
+    out = patches.reshape(n, ckk, -1).transpose(0, 2, 1)
+    return {"Out": out}
+
+
+@register_op("row_conv")
+def row_conv(X, Filter, Length=None, **_):
+    """Lookahead row convolution (row_conv_op.cc, DeepSpeech2).  X is padded
+    dense [batch, time, dim]; Filter [future_context+1, dim]."""
+    ctx_len, dim = Filter.shape
+    b, t, d = X.shape
+    out = jnp.zeros_like(X)
+    xp = jnp.pad(X, ((0, 0), (0, ctx_len - 1), (0, 0)))
+    out = sum(xp[:, i : i + t, :] * Filter[i][None, None, :] for i in range(ctx_len))
+    if Length is not None:
+        mask = (jnp.arange(t)[None, :] < Length[:, None])[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return {"Out": out}

@@ -1,0 +1,171 @@
+"""Optimizer update ops.
+
+Reference: ``sgd_op, momentum_op, adam_op, adamax_op, adagrad_op, adadelta_op,
+decayed_adagrad_op, rmsprop_op, ftrl_op, proximal_{gd,adagrad}_op`` — each a
+standalone op so the same update rule can run trainer-side or pserver-side
+(``recv_op.cc:100``).  Same shape here: pure functions (Param, Grad, state…)
+-> (ParamOut, state…); the Executor routes ParamOut back into the persistable
+state, giving XLA an in-place donated update."""
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+@register_op("sgd")
+def sgd(Param, Grad, LearningRate, **_):
+    lr = _f32(LearningRate).reshape(())
+    out = _f32(Param) - lr * _f32(Grad)
+    return {"ParamOut": out.astype(Param.dtype)}
+
+
+@register_op("momentum")
+def momentum(Param, Grad, Velocity, LearningRate, mu=0.9, use_nesterov=False, **_):
+    lr = _f32(LearningRate).reshape(())
+    v = mu * _f32(Velocity) + _f32(Grad)
+    if use_nesterov:
+        p = _f32(Param) - (_f32(Grad) + mu * v) * lr
+    else:
+        p = _f32(Param) - lr * v
+    return {"ParamOut": p.astype(Param.dtype), "VelocityOut": v.astype(Velocity.dtype)}
+
+
+@register_op("adagrad")
+def adagrad(Param, Grad, Moment, LearningRate, epsilon=1e-6, **_):
+    lr = _f32(LearningRate).reshape(())
+    g = _f32(Grad)
+    m = _f32(Moment) + g * g
+    p = _f32(Param) - lr * g / (jnp.sqrt(m) + epsilon)
+    return {"ParamOut": p.astype(Param.dtype), "MomentOut": m.astype(Moment.dtype)}
+
+
+@register_op("adam")
+def adam(
+    Param, Grad, Moment1, Moment2, LearningRate, Beta1Pow, Beta2Pow,
+    beta1=0.9, beta2=0.999, epsilon=1e-8, **_,
+):
+    lr = _f32(LearningRate).reshape(())
+    g = _f32(Grad)
+    m1 = beta1 * _f32(Moment1) + (1 - beta1) * g
+    m2 = beta2 * _f32(Moment2) + (1 - beta2) * g * g
+    b1p = _f32(Beta1Pow).reshape(())
+    b2p = _f32(Beta2Pow).reshape(())
+    lr_t = lr * jnp.sqrt(1 - b2p * beta2) / (1 - b1p * beta1)
+    p = _f32(Param) - lr_t * m1 / (jnp.sqrt(m2) + epsilon)
+    return {
+        "ParamOut": p.astype(Param.dtype),
+        "Moment1Out": m1.astype(Moment1.dtype),
+        "Moment2Out": m2.astype(Moment2.dtype),
+        "Beta1PowOut": (b1p * beta1).reshape(Beta1Pow.shape).astype(Beta1Pow.dtype),
+        "Beta2PowOut": (b2p * beta2).reshape(Beta2Pow.shape).astype(Beta2Pow.dtype),
+    }
+
+
+@register_op("adamax")
+def adamax(
+    Param, Grad, Moment, InfNorm, LearningRate, Beta1Pow,
+    beta1=0.9, beta2=0.999, epsilon=1e-8, **_,
+):
+    lr = _f32(LearningRate).reshape(())
+    g = _f32(Grad)
+    m = beta1 * _f32(Moment) + (1 - beta1) * g
+    u = jnp.maximum(beta2 * _f32(InfNorm), jnp.abs(g))
+    b1p = _f32(Beta1Pow).reshape(()) * beta1
+    p = _f32(Param) - (lr / (1 - b1p)) * m / (u + epsilon)
+    return {
+        "ParamOut": p.astype(Param.dtype),
+        "MomentOut": m.astype(Moment.dtype),
+        "InfNormOut": u.astype(InfNorm.dtype),
+        "Beta1PowOut": b1p.reshape(Beta1Pow.shape).astype(Beta1Pow.dtype),
+    }
+
+
+@register_op("adadelta")
+def adadelta(Param, Grad, AvgSquaredGrad, AvgSquaredUpdate, rho=0.95, epsilon=1e-6, **_):
+    g = _f32(Grad)
+    asg = rho * _f32(AvgSquaredGrad) + (1 - rho) * g * g
+    update = -jnp.sqrt((_f32(AvgSquaredUpdate) + epsilon) / (asg + epsilon)) * g
+    asu = rho * _f32(AvgSquaredUpdate) + (1 - rho) * update * update
+    p = _f32(Param) + update
+    return {
+        "ParamOut": p.astype(Param.dtype),
+        "AvgSquaredGradOut": asg.astype(AvgSquaredGrad.dtype),
+        "AvgSquaredUpdateOut": asu.astype(AvgSquaredUpdate.dtype),
+    }
+
+
+@register_op("decayed_adagrad")
+def decayed_adagrad(Param, Grad, Moment, LearningRate, decay=0.95, epsilon=1e-6, **_):
+    lr = _f32(LearningRate).reshape(())
+    g = _f32(Grad)
+    m = decay * _f32(Moment) + (1 - decay) * g * g
+    p = _f32(Param) - lr * g / (jnp.sqrt(m) + epsilon)
+    return {"ParamOut": p.astype(Param.dtype), "MomentOut": m.astype(Moment.dtype)}
+
+
+@register_op("rmsprop")
+def rmsprop(Param, Grad, MeanSquare, Moment, LearningRate, epsilon=1e-10, decay=0.9, momentum=0.0, **_):
+    lr = _f32(LearningRate).reshape(())
+    g = _f32(Grad)
+    ms = decay * _f32(MeanSquare) + (1 - decay) * g * g
+    mom = momentum * _f32(Moment) + lr * g / jnp.sqrt(ms + epsilon)
+    p = _f32(Param) - mom
+    return {
+        "ParamOut": p.astype(Param.dtype),
+        "MeanSquareOut": ms.astype(MeanSquare.dtype),
+        "MomentOut": mom.astype(Moment.dtype),
+    }
+
+
+@register_op("ftrl")
+def ftrl(Param, Grad, SquaredAccumulator, LinearAccumulator, LearningRate,
+         l1=0.0, l2=0.0, lr_power=-0.5, **_):
+    lr = _f32(LearningRate).reshape(())
+    g = _f32(Grad)
+    sq = _f32(SquaredAccumulator)
+    lin = _f32(LinearAccumulator)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * _f32(Param)
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p = pre / denom
+    return {
+        "ParamOut": p.astype(Param.dtype),
+        "SquaredAccumOut": new_sq.astype(SquaredAccumulator.dtype),
+        "LinearAccumOut": new_lin.astype(LinearAccumulator.dtype),
+    }
+
+
+@register_op("proximal_gd")
+def proximal_gd(Param, Grad, LearningRate, l1=0.0, l2=0.0, **_):
+    lr = _f32(LearningRate).reshape(())
+    prox = _f32(Param) - lr * _f32(Grad)
+    if l1 > 0:
+        p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    else:
+        p = prox / (1.0 + lr * l2)
+    return {"ParamOut": p.astype(Param.dtype)}
+
+
+@register_op("proximal_adagrad")
+def proximal_adagrad(Param, Grad, Moment, LearningRate, l1=0.0, l2=0.0, **_):
+    g = _f32(Grad)
+    m = _f32(Moment) + g * g
+    lr = _f32(LearningRate).reshape(()) / jnp.sqrt(m)
+    prox = _f32(Param) - lr * g
+    if l1 > 0:
+        p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    else:
+        p = prox / (1.0 + lr * l2)
+    return {"ParamOut": p.astype(Param.dtype), "MomentOut": m.astype(Moment.dtype)}

@@ -1,0 +1,81 @@
+"""Random ops.
+
+Reference: ``uniform_random_op``, ``gaussian_random_op``, ``dropout_op``
+(cuRAND / std::mt19937 with per-op ``seed`` attrs).  TPU-native randomness is
+functional: every random op derives a deterministic PRNG key either from its
+``seed`` attr (startup-program initializers — reproducible like the
+reference's seeded Philox) or from the executor's per-step key stream
+(dropout etc., which must differ step to step)."""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.dtypes import convert_dtype
+
+
+def _seed_key(seed, ctx):
+    if isinstance(seed, tuple):
+        base, ctr = seed
+        return jax.random.fold_in(jax.random.PRNGKey(base), ctr)
+    if seed:
+        return jax.random.PRNGKey(int(seed))
+    if ctx is not None:
+        return ctx.next_op_key()
+    return jax.random.PRNGKey(0)
+
+
+@register_op("uniform_random")
+def uniform_random(shape=(), dtype="float32", min=-1.0, max=1.0, seed=0, _ctx=None, **_):
+    key = _seed_key(seed, _ctx)
+    return {
+        "Out": jax.random.uniform(
+            key, tuple(shape), dtype=jnp.float32, minval=min, maxval=max
+        ).astype(convert_dtype(dtype))
+    }
+
+
+@register_op("gaussian_random")
+def gaussian_random(shape=(), dtype="float32", mean=0.0, std=1.0, seed=0, _ctx=None, **_):
+    key = _seed_key(seed, _ctx)
+    out = mean + std * jax.random.normal(key, tuple(shape), dtype=jnp.float32)
+    return {"Out": out.astype(convert_dtype(dtype))}
+
+
+@register_op("truncated_gaussian_random")
+def truncated_gaussian_random(
+    shape=(), dtype="float32", mean=0.0, std=1.0, seed=0, _ctx=None, **_
+):
+    key = _seed_key(seed, _ctx)
+    out = mean + std * jax.random.truncated_normal(
+        key, -2.0, 2.0, tuple(shape), dtype=jnp.float32
+    )
+    return {"Out": out.astype(convert_dtype(dtype))}
+
+
+@register_op("dropout", stateful_rng=True)
+def dropout(X, dropout_prob=0.5, is_test=False, seed=0, fix_seed=False, _key=None, **_):
+    # v0.11 semantics (dropout_op.h): train -> out = x * mask (no rescale);
+    # test -> out = x * (1 - p) so train/test magnitudes agree.
+    if is_test:
+        return {"Out": X * (1.0 - dropout_prob), "Mask": jnp.ones_like(X)}
+    if dropout_prob == 0.0:
+        return {"Out": X, "Mask": jnp.ones_like(X)}
+    key = jax.random.PRNGKey(int(seed)) if fix_seed else _key
+    keep = 1.0 - dropout_prob
+    mask = jax.random.bernoulli(key, keep, X.shape).astype(X.dtype)
+    return {"Out": X * mask, "Mask": mask}
+
+
+@register_op("random_crop", stateful_rng=True)
+def random_crop(X, shape=(), _key=None, **_):
+    out_shape = tuple(shape)
+    starts = []
+    key = _key if _key is not None else jax.random.PRNGKey(0)
+    for i, (full, crop) in enumerate(zip(X.shape, out_shape)):
+        key, sub = jax.random.split(key)
+        starts.append(
+            jax.random.randint(sub, (), 0, full - crop + 1) if full > crop else 0
+        )
+    out = jax.lax.dynamic_slice(X, [jnp.asarray(s) for s in starts], out_shape)
+    return {"Out": out}

@@ -1,0 +1,225 @@
+"""Recurrent ops — LSTM / GRU families.
+
+Reference: ``lstm_op``, ``lstmp_op``, ``gru_op``, ``lstm_unit_op``,
+``gru_unit_op`` batched via ``math/sequence2batch`` (reorder ragged
+sequences into per-timestep dense batches) and fused CUDA cell kernels
+(``hl_cuda_lstm.cu``, ``math/detail/lstm_kernel.h``).
+
+TPU-native form: the batch is already padded dense [b, t, ...], so the
+sequence2batch machinery vanishes — a single ``lax.scan`` over time runs the
+cell; XLA unrolls the gate algebra onto MXU matmuls (the hidden-to-gates
+GEMM dominates) and masking freezes finished rows.  Gate order convention:
+i, f, c(candidate), o — gradients are consistent by construction (jax AD).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .sequence_ops import time_mask
+
+
+def _act(name):
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda x: x,
+        "linear": lambda x: x,
+    }[name]
+
+
+def lstm_cell(x_gates, h, c, weight_hh, bias=None, peephole=None,
+              gate_act="sigmoid", cell_act="tanh", cand_act="tanh"):
+    """One LSTM step. x_gates [b, 4d] (input already projected), h/c [b, d],
+    weight_hh [d, 4d]; peephole (wi, wf, wo) each [d] or None."""
+    d = h.shape[-1]
+    acc = jnp.float32 if h.dtype in (jnp.bfloat16, jnp.float16) else None
+    gates = x_gates + jnp.dot(h, weight_hh.astype(h.dtype), preferred_element_type=acc).astype(h.dtype)
+    if bias is not None:
+        gates = gates + bias.astype(h.dtype)
+    gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+    ga, ca = _act(gate_act), _act(cand_act)
+    if peephole is not None:
+        wi, wf, wo = peephole
+        i = ga(gi + wi * c)
+        f = ga(gf + wf * c)
+    else:
+        i = ga(gi)
+        f = ga(gf)
+    c_new = f * c + i * ca(gc)
+    if peephole is not None:
+        o = ga(go + peephole[2] * c_new)
+    else:
+        o = ga(go)
+    h_new = o * _act(cell_act)(c_new)
+    return h_new, c_new
+
+
+@register_op("lstm")
+def lstm(
+    Input, Weight, Bias=None, H0=None, C0=None, Length=None,
+    use_peepholes=False, is_reverse=False,
+    gate_activation="sigmoid", cell_activation="tanh", candidate_activation="tanh",
+    **_,
+):
+    """Full-sequence LSTM (lstm_op.cc).  Input [b, t, 4d] (pre-projected,
+    as in the reference where the input GEMM is a separate fc), Weight
+    [d, 4d] recurrent weights, Bias [4d] or [7d] with peepholes."""
+    b, t, d4 = Input.shape
+    d = d4 // 4
+    h0 = H0 if H0 is not None else jnp.zeros((b, d), Input.dtype)
+    c0 = C0 if C0 is not None else jnp.zeros((b, d), Input.dtype)
+    peep = None
+    bias = None
+    if Bias is not None:
+        if use_peepholes and Bias.shape[-1] == 7 * d:
+            bias = Bias[..., : 4 * d].reshape(4 * d)
+            wi, wf, wo = (Bias[..., 4 * d : 5 * d].reshape(d),
+                          Bias[..., 5 * d : 6 * d].reshape(d),
+                          Bias[..., 6 * d :].reshape(d))
+            peep = (wi, wf, wo)
+        else:
+            bias = Bias.reshape(-1)
+
+    mask = time_mask(Length, t, Input.dtype) if Length is not None else jnp.ones((b, t), Input.dtype)
+    xs = jnp.swapaxes(Input, 0, 1)  # [t, b, 4d]
+    ms = jnp.swapaxes(mask, 0, 1)[..., None]  # [t, b, 1]
+    if is_reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(carry, xm):
+        h, c = carry
+        x, m = xm
+        h_new, c_new = lstm_cell(
+            x, h, c, Weight, bias, peep,
+            gate_activation, cell_activation, candidate_activation,
+        )
+        h = m * h_new + (1 - m) * h
+        c = m * c_new + (1 - m) * c
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, ms))
+    if is_reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return {
+        "Hidden": jnp.swapaxes(hs, 0, 1),
+        "Cell": jnp.swapaxes(cs, 0, 1),
+    }
+
+
+@register_op("lstmp")
+def lstmp(
+    Input, Weight, ProjWeight, Bias=None, H0=None, C0=None, Length=None,
+    use_peepholes=False, is_reverse=False,
+    gate_activation="sigmoid", cell_activation="tanh",
+    candidate_activation="tanh", proj_activation="identity", **_,
+):
+    """LSTM with recurrent projection (lstmp_op.cc): hidden state projected
+    to lower dim before recurrence.  Weight [p, 4d], ProjWeight [d, p]."""
+    b, t, d4 = Input.shape
+    d = d4 // 4
+    p = ProjWeight.shape[1]
+    h0 = H0 if H0 is not None else jnp.zeros((b, p), Input.dtype)
+    c0 = C0 if C0 is not None else jnp.zeros((b, d), Input.dtype)
+    bias = Bias.reshape(-1)[: 4 * d] if Bias is not None else None
+    peep = None
+    if Bias is not None and use_peepholes and Bias.reshape(-1).shape[0] == 7 * d:
+        fb = Bias.reshape(-1)
+        peep = (fb[4 * d : 5 * d], fb[5 * d : 6 * d], fb[6 * d :])
+    pact = _act(proj_activation)
+
+    mask = time_mask(Length, t, Input.dtype) if Length is not None else jnp.ones((b, t), Input.dtype)
+    xs = jnp.swapaxes(Input, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[..., None]
+    if is_reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(carry, xm):
+        r, c = carry
+        x, m = xm
+        h_new, c_new = lstm_cell(
+            x, r, c, Weight, bias, peep,
+            gate_activation, cell_activation, candidate_activation,
+        )
+        r_new = pact(jnp.dot(h_new, ProjWeight.astype(h_new.dtype)))
+        r = m * r_new + (1 - m) * r
+        c = m * c_new + (1 - m) * c
+        return (r, c), r
+
+    (_, _), rs = jax.lax.scan(step, (h0, c0), (xs, ms))
+    if is_reverse:
+        rs = rs[::-1]
+    return {"Projection": jnp.swapaxes(rs, 0, 1)}
+
+
+def gru_cell(x_gates, h, weight_hh, bias=None, gate_act="sigmoid", cand_act="tanh"):
+    """x_gates [b, 3d] (order u, r, c), weight_hh [d, 3d] (u,r parts) with
+    candidate part [d, d] at the tail — matches reference gru layout where
+    candidate uses (r*h) @ W_c."""
+    d = h.shape[-1]
+    acc = jnp.float32 if h.dtype in (jnp.bfloat16, jnp.float16) else None
+    w_ur = weight_hh[:, : 2 * d]
+    w_c = weight_hh[:, 2 * d :]
+    g = x_gates
+    if bias is not None:
+        g = g + bias.astype(h.dtype)
+    g_ur = g[..., : 2 * d] + jnp.dot(h, w_ur.astype(h.dtype), preferred_element_type=acc).astype(h.dtype)
+    ga, ca = _act(gate_act), _act(cand_act)
+    u = ga(g_ur[..., :d])
+    r = ga(g_ur[..., d:])
+    c = ca(g[..., 2 * d :] + jnp.dot(r * h, w_c.astype(h.dtype), preferred_element_type=acc).astype(h.dtype))
+    return u * h + (1 - u) * c
+
+
+@register_op("gru")
+def gru(
+    Input, Weight, Bias=None, H0=None, Length=None, is_reverse=False,
+    gate_activation="sigmoid", activation="tanh", **_,
+):
+    """Full-sequence GRU (gru_op.cc). Input [b, t, 3d], Weight [d, 3d]."""
+    b, t, d3 = Input.shape
+    d = d3 // 3
+    h0 = H0 if H0 is not None else jnp.zeros((b, d), Input.dtype)
+    bias = Bias.reshape(-1) if Bias is not None else None
+
+    mask = time_mask(Length, t, Input.dtype) if Length is not None else jnp.ones((b, t), Input.dtype)
+    xs = jnp.swapaxes(Input, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[..., None]
+    if is_reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(h, xm):
+        x, m = xm
+        h_new = gru_cell(x, h, Weight, bias, gate_activation, activation)
+        h = m * h_new + (1 - m) * h
+        return h, h
+
+    _, hs = jax.lax.scan(step, h0, (xs, ms))
+    if is_reverse:
+        hs = hs[::-1]
+    return {"Hidden": jnp.swapaxes(hs, 0, 1)}
+
+
+@register_op("lstm_unit")
+def lstm_unit(X, C_prev, forget_bias=0.0, **_):
+    """Single fused LSTM cell step (lstm_unit_op.cc): X [b, 4d] packed
+    gates, gate order i, f, c, o with tanh/sigmoid activations."""
+    d = C_prev.shape[-1]
+    gi, gf, gc, go = jnp.split(X, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * C_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit")
+def gru_unit(Input, HiddenPrev, Weight, Bias=None,
+             gate_activation="sigmoid", activation="tanh", **_):
+    """Single GRU step (gru_unit_op.cc)."""
+    h = gru_cell(
+        Input if Bias is None else Input + 0.0,  # bias added inside cell
+        HiddenPrev, Weight, Bias, gate_activation, activation,
+    )
+    return {"Hidden": h}

@@ -1,0 +1,253 @@
+"""Sequence ops — the TPU-native replacement for the reference LoD system.
+
+The reference stores ragged batches as packed tensors + LoD offset vectors
+(``lod_tensor.h:58``) and every sequence op walks offsets (e.g.
+``sequence_pooling.cc``, ``hl_cuda_sequence.cu``).  XLA wants static shapes,
+so here a "sequence batch" is a padded dense tensor ``[batch, max_len, ...]``
+plus an int32 ``Length`` [batch] (the shadow ``<name>@LENGTH`` variable) and
+ops are mask-aware.  No padding *waste* survives compilation where it
+matters: masked lanes vectorize on the VPU, and bucketing in the DataFeeder
+keeps max_len tight (SURVEY §5 long-context notes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def time_mask(Length, max_len, dtype=jnp.float32):
+    """[batch, max_len] 1/0 mask from lengths."""
+    return (jnp.arange(max_len)[None, :] < Length[:, None]).astype(dtype)
+
+
+def _mask_for(X, Length):
+    m = time_mask(Length, X.shape[1], X.dtype)
+    return m.reshape(m.shape + (1,) * (X.ndim - 2))
+
+
+@register_op("sequence_pool")
+def sequence_pool(X, Length=None, pooltype="SUM", **_):
+    b, t = X.shape[0], X.shape[1]
+    if Length is None:
+        Length = jnp.full((b,), t, dtype=jnp.int32)
+    m = _mask_for(X, Length)
+    lens = Length.astype(jnp.float32).reshape((b,) + (1,) * (X.ndim - 2))
+    pt = pooltype.upper()
+    if pt == "SUM":
+        out = jnp.sum(X * m, axis=1)
+    elif pt == "AVERAGE":
+        out = jnp.sum(X * m, axis=1) / jnp.maximum(lens, 1.0)
+    elif pt == "SQRT":
+        out = jnp.sum(X * m, axis=1) / jnp.sqrt(jnp.maximum(lens, 1.0))
+    elif pt == "MAX":
+        neg = jnp.asarray(-1e38, X.dtype)
+        out = jnp.max(jnp.where(m > 0, X, neg), axis=1)
+    elif pt == "LAST":
+        idx = jnp.maximum(Length - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(
+            X, idx.reshape((b, 1) + (1,) * (X.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif pt == "FIRST":
+        out = X[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {pooltype}")
+    return {"Out": out}
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(X, Length=None, **_):
+    # X: [batch, max_len] (scores per timestep)
+    if Length is None:
+        return {"Out": jax.nn.softmax(X, axis=1)}
+    m = time_mask(Length, X.shape[1], jnp.bool_)
+    neg = jnp.asarray(-1e38, X.dtype)
+    sm = jax.nn.softmax(jnp.where(m, X, neg), axis=1)
+    return {"Out": jnp.where(m, sm, 0.0)}
+
+
+@register_op("sequence_conv")
+def sequence_conv(X, Filter, Length=None, contextLength=3, contextStart=None, **_):
+    """Context-window projection (sequence_conv_op + math/context_project).
+    X [b,t,d], Filter [contextLength*d, out]; rows outside the sequence are
+    zero (reference pads with zeros unless a padding-trainable matrix is
+    given)."""
+    b, t, d = X.shape
+    start = contextStart if contextStart is not None else -((contextLength - 1) // 2)
+    if Length is not None:
+        X = X * _mask_for(X, Length)
+    cols = []
+    for i in range(contextLength):
+        off = start + i
+        shifted = jnp.roll(X, -off, axis=1)
+        if off > 0:
+            mask = (jnp.arange(t) < t - off)[None, :, None]
+        elif off < 0:
+            mask = (jnp.arange(t) >= -off)[None, :, None]
+        else:
+            mask = None
+        cols.append(jnp.where(mask, shifted, 0.0) if mask is not None else shifted)
+    ctx = jnp.concatenate(cols, axis=-1)  # [b,t,ctx*d]
+    out = jnp.einsum("btc,co->bto", ctx, Filter.astype(X.dtype))
+    if Length is not None:
+        out = out * _mask_for(out, Length)
+    return {"Out": out}
+
+
+@register_op("sequence_concat")
+def sequence_concat(X, Length=None, axis=1, **_):
+    """Concatenate sequences per batch item along time (axis=1 semantics of
+    reference's level-0 concat): result lengths add."""
+    xs = X if isinstance(X, (list, tuple)) else [X]
+    lens = Length if isinstance(Length, (list, tuple)) else ([Length] if Length is not None else None)
+    if axis != 1 or lens is None:
+        return {"Out": jnp.concatenate(xs, axis=axis)}
+    b = xs[0].shape[0]
+    total = sum(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((b, total) + feat, xs[0].dtype)
+    out_len = jnp.zeros((b,), jnp.int32)
+    pos = jnp.zeros((b,), jnp.int32)
+    for x, ln in zip(xs, lens):
+        t = x.shape[1]
+        idx = pos[:, None] + jnp.arange(t)[None, :]
+        valid = time_mask(ln, t, jnp.bool_)
+        idx = jnp.where(valid, idx, total)  # out-of-range drops
+        outpad = jnp.concatenate([out, jnp.zeros((b, 1) + feat, out.dtype)], axis=1)
+        bidx = jnp.arange(b)[:, None].repeat(t, 1)
+        outpad = outpad.at[bidx, idx].set(jnp.where(valid.reshape(valid.shape + (1,) * len(feat)), x, outpad[bidx, idx]))
+        out = outpad[:, :total]
+        pos = pos + ln.astype(jnp.int32)
+        out_len = out_len + ln.astype(jnp.int32)
+    return {"Out": out, "OutLength": out_len}
+
+
+@register_op("sequence_expand")
+def sequence_expand(X, Y=None, YLength=None, **_):
+    """Reference sequence_expand_op: broadcast each batch item's vector
+    across its target sequence's timesteps.  X [b, d] (or [b,1,d]),
+    out [b, max_len_y, d] masked by YLength."""
+    if Y is None:
+        # YLength is a tracer under jit, so the time dim cannot come from it
+        raise ValueError("sequence_expand requires the Y input (its static "
+                         "max_len defines the output time dimension)")
+    x = X if X.ndim == 3 else X[:, None, :]
+    t = Y.shape[1]
+    out = jnp.broadcast_to(x, (x.shape[0], t) + x.shape[2:])
+    if YLength is not None:
+        out = out * _mask_for(out, YLength)
+    return {"Out": out}
+
+
+@register_op("sequence_slice")
+def sequence_slice(X, Offset, SeqLength, **_):
+    """Per-sequence slice (sequence_slice_op.cc): take [offset, offset+len)
+    from each row; output stays padded to X's max_len."""
+    b, t = X.shape[0], X.shape[1]
+    off = Offset.reshape(-1).astype(jnp.int32)
+    ln = SeqLength.reshape(-1).astype(jnp.int32)
+    idx = off[:, None] + jnp.arange(t)[None, :]
+    idx = jnp.clip(idx, 0, t - 1)
+    out = jnp.take_along_axis(X, idx.reshape((b, t) + (1,) * (X.ndim - 2)), axis=1)
+    out = out * _mask_for(out, ln)
+    return {"Out": out, "OutLength": ln}
+
+
+@register_op("sequence_erase", nondiff=True)
+def sequence_erase(X, Length=None, tokens=(), **_):
+    """Remove given token ids, compacting each sequence left
+    (sequence_erase_op.cc).  X int [b, t]."""
+    b, t = X.shape
+    keep = jnp.ones_like(X, dtype=jnp.bool_)
+    for tok in tokens:
+        keep = jnp.logical_and(keep, X != tok)
+    if Length is not None:
+        keep = jnp.logical_and(keep, time_mask(Length, t, jnp.bool_))
+    # stable compaction: sort by (not keep) preserving order
+    order = jnp.argsort(jnp.where(keep, 0, 1) * t + jnp.arange(t)[None, :], axis=1)
+    gathered = jnp.take_along_axis(X, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(time_mask(new_len, t, jnp.bool_), gathered, 0)
+    return {"Out": out, "OutLength": new_len}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(X, Length=None, new_dim=0, **_):
+    b, t, d = X.shape
+    factor = d / new_dim
+    new_t = int(t * d // new_dim)
+    out = X.reshape(b, new_t, new_dim)
+    new_len = None
+    if Length is not None:
+        new_len = (Length.astype(jnp.float32) * factor).astype(jnp.int32)
+    return {"Out": out, "OutLength": new_len if new_len is not None else jnp.full((b,), new_t, jnp.int32)}
+
+
+@register_op("sequence_scale")
+def sequence_scale(X, Scales, Length=None, **_):
+    """Per-sequence scaling (math/sequence_scale, used by warpctc grad)."""
+    out = X * Scales.reshape((-1,) + (1,) * (X.ndim - 1))
+    return {"Out": out}
+
+
+@register_op("edit_distance", nondiff=True)
+def edit_distance(Hyps, Refs, HypsLength=None, RefsLength=None, normalized=False, **_):
+    """Levenshtein distance per batch row (edit_distance_op.cc).  Hyps/Refs
+    int [b, t]; computed with a lax.scan DP over the reference axis."""
+    b, th = Hyps.shape
+    tr = Refs.shape[1]
+    hlen = HypsLength if HypsLength is not None else jnp.full((b,), th, jnp.int32)
+    rlen = RefsLength if RefsLength is not None else jnp.full((b,), tr, jnp.int32)
+
+    def per_row(hyp, ref, hl, rl):
+        # dp over prefix lengths; row i = distance(hyp[:i], ref[:j]) rolled by scan over i
+        init = jnp.arange(tr + 1, dtype=jnp.int32)  # distance(empty, ref[:j])
+        # clamp to rl: positions beyond rl should mirror rl (we mask at the end)
+        def step(prev_row, i):
+            ins = prev_row[0] + 1  # j=0 column: distance(hyp[:i+1], empty)
+
+            def inner(carry, j):
+                left = carry  # dp[i+1][j]
+                sub_cost = jnp.where(hyp[i] == ref[j], 0, 1)
+                val = jnp.minimum(
+                    jnp.minimum(prev_row[j + 1] + 1, left + 1),
+                    prev_row[j] + sub_cost,
+                )
+                # beyond valid hyp length, copy previous row (no-op)
+                val = jnp.where(i < hl, val, prev_row[j + 1])
+                return val, val
+
+            _, rest = jax.lax.scan(inner, jnp.where(i < hl, ins, prev_row[0]), jnp.arange(tr))
+            first = jnp.where(i < hl, ins, prev_row[0])
+            row = jnp.concatenate([first[None], rest])
+            return row, None
+
+        final, _ = jax.lax.scan(step, init, jnp.arange(th))
+        return final[rl]
+
+    dist = jax.vmap(per_row)(Hyps, Refs, hlen, rlen).astype(jnp.float32)
+    if normalized:
+        dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return {"Out": dist[:, None], "SequenceNum": jnp.asarray([b], jnp.int32)}
+
+
+@register_op("ctc_align", nondiff=True)
+def ctc_align(Input, Length=None, blank=0, merge_repeated=True, **_):
+    """CTC greedy decode alignment (ctc_align_op.cc): collapse repeats then
+    remove blanks.  Input int [b, t] of argmax ids."""
+    b, t = Input.shape
+    x = Input
+    if merge_repeated:
+        prev = jnp.concatenate([jnp.full((b, 1), -1, x.dtype), x[:, :-1]], axis=1)
+        keep = x != prev
+    else:
+        keep = jnp.ones_like(x, dtype=jnp.bool_)
+    keep = jnp.logical_and(keep, x != blank)
+    if Length is not None:
+        keep = jnp.logical_and(keep, time_mask(Length, t, jnp.bool_))
+    order = jnp.argsort(jnp.where(keep, 0, 1) * t + jnp.arange(t)[None, :], axis=1)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(time_mask(new_len, t, jnp.bool_), gathered, 0)
+    return {"Output": out, "OutputLength": new_len}

@@ -1,0 +1,180 @@
+"""Tensor manipulation & fill ops (reference "Data/misc" group, SURVEY §2.2):
+fill/assign/reshape/transpose/split/concat/expand/gather/scatter/pad/crop/
+multiplex/increment/lookup_table …"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from ..core.dtypes import convert_dtype
+
+
+@register_op("fill_constant")
+def fill_constant(shape=(), dtype="float32", value=0.0, **_):
+    return {"Out": jnp.full(tuple(shape), value, dtype=convert_dtype(dtype))}
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(
+    Input, shape=(), dtype="float32", value=0.0, input_dim_idx=0, output_dim_idx=0, **_
+):
+    shape = list(shape)
+    shape[output_dim_idx] = Input.shape[input_dim_idx]
+    return {"Out": jnp.full(tuple(shape), value, dtype=convert_dtype(dtype))}
+
+
+@register_op("fill_zeros_like")
+def fill_zeros_like(X, **_):
+    return {"Out": jnp.zeros_like(X)}
+
+
+@register_op("assign")
+def assign(X, **_):
+    return {"Out": X}
+
+
+@register_op("assign_value")
+def assign_value(shape=(), dtype="float32", values=(), **_):
+    arr = np.asarray(values, dtype=convert_dtype(dtype)).reshape(tuple(shape))
+    return {"Out": jnp.asarray(arr)}
+
+
+@register_op("shape")
+def shape_op(Input, **_):
+    return {"Out": jnp.asarray(Input.shape, dtype=jnp.int32)}
+
+
+@register_op("reshape")
+def reshape(X, shape=(), **_):
+    shape = [int(s) for s in shape]
+    return {"Out": X.reshape(tuple(shape))}
+
+
+@register_op("transpose")
+def transpose(X, axis=(), **_):
+    return {"Out": jnp.transpose(X, tuple(axis))}
+
+
+@register_op("split")
+def split(X, num=0, sections=(), axis=0, **_):
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(X, idx, axis=axis)
+    else:
+        outs = jnp.split(X, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("concat")
+def concat(X, axis=0, **_):
+    xs = X if isinstance(X, (list, tuple)) else [X]
+    return {"Out": jnp.concatenate(xs, axis=axis)}
+
+
+@register_op("expand")
+def expand(X, expand_times=(), **_):
+    return {"Out": jnp.tile(X, tuple(expand_times))}
+
+
+@register_op("gather")
+def gather(X, Index, **_):
+    return {"Out": jnp.take(X, Index.astype(jnp.int32), axis=0)}
+
+
+@register_op("scatter")
+def scatter(X, Ids, Updates, overwrite=True, **_):
+    ids = Ids.astype(jnp.int32)
+    if overwrite:
+        return {"Out": X.at[ids].set(Updates)}
+    return {"Out": X.at[ids].add(Updates)}
+
+
+@register_op("pad")
+def pad(X, paddings=(), pad_value=0.0, **_):
+    pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(X.ndim)]
+    return {"Out": jnp.pad(X, pads, constant_values=pad_value)}
+
+
+@register_op("crop")
+def crop(X, Y=None, offsets=(), shape=(), **_):
+    tgt = Y.shape if Y is not None else tuple(shape)
+    off = list(offsets) if offsets else [0] * X.ndim
+    slices = tuple(slice(o, o + s) for o, s in zip(off, tgt))
+    return {"Out": X[slices]}
+
+
+@register_op("multiplex")
+def multiplex(Ids, X, **_):
+    xs = jnp.stack(X if isinstance(X, (list, tuple)) else [X], axis=0)
+    ids = Ids.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("increment")
+def increment(X, step=1.0, **_):
+    return {"Out": X + jnp.asarray(step, dtype=X.dtype)}
+
+
+@register_op("one_hot")
+def one_hot(X, depth=0, **_):
+    ids = X.reshape(X.shape[:-1]) if X.shape and X.shape[-1] == 1 else X
+    return {"Out": jax.nn.one_hot(ids.astype(jnp.int32), depth)}
+
+
+@register_op("lookup_table")
+def lookup_table(W, Ids, padding_idx=-1, is_sparse=False, **_):
+    """Embedding lookup (reference lookup_table_op.cc).  Ids may be [...,1]
+    (fluid convention).  ``is_sparse`` is advisory here: gradients flow as
+    dense arrays single-host; the distributed embedding service (parallel/
+    sparse) row-shards instead — SelectedRows' job (selected_rows.h)."""
+    ids = Ids
+    if ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    ids = ids.astype(jnp.int32)
+    out = jnp.take(W, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+    return {"Out": out}
+
+
+@register_op("embedding_grad_rows")
+def embedding_grad_rows(Grad, Ids, table_height=0, **_):
+    """Helper exposing the SelectedRows idea: scatter-add token grads into a
+    dense table of zeros (used by the sparse pserver path's tests)."""
+    ids = Ids.reshape(-1).astype(jnp.int32)
+    g = Grad.reshape((ids.shape[0], -1))
+    table = jnp.zeros((table_height, g.shape[1]), dtype=Grad.dtype)
+    return {"Out": table.at[ids].add(g)}
+
+
+@register_op("top_k")
+def top_k(X, k=1, **_):
+    vals, idx = jax.lax.top_k(X, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int32)}
+
+
+@register_op("arg_max", nondiff=True)
+def arg_max(X, axis=-1, **_):
+    return {"Out": jnp.argmax(X, axis=axis).astype(jnp.int32)}
+
+
+@register_op("arg_min", nondiff=True)
+def arg_min(X, axis=-1, **_):
+    return {"Out": jnp.argmin(X, axis=axis).astype(jnp.int32)}
+
+
+@register_op("is_empty", nondiff=True)
+def is_empty(X, **_):
+    return {"Out": jnp.asarray(int(np.prod(X.shape)) == 0)}
+
+
+@register_op("isfinite", nondiff=True)
+def isfinite(X, **_):
+    xs = X if isinstance(X, (list, tuple)) else [X]
+    ok = jnp.asarray(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+    return {"Out": ok}

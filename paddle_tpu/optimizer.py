@@ -1,0 +1,411 @@
+"""Optimizers (reference: fluid/optimizer.py — SGD/Momentum/Adagrad/Adam/
+Adamax/DecayedAdagrad appending optimize ops per parameter; plus the legacy
+FirstOrderOptimizer family paddle/parameter/FirstOrderOptimizer.h and the
+pserver-side paddle/optimizer C lib — all the same update rules, realized
+here as the optimizer ops in ops/optimizer_ops.py).
+
+``minimize(loss)`` appends: backward marker (jax.grad boundary) → clip ops →
+regularization ops → one optimizer op per parameter + accumulators.  The
+whole update fuses into the jitted train step."""
+
+import numpy as np
+
+from .backward import append_backward
+from .clip import append_gradient_clip_ops, GradientClipByGlobalNorm
+from .regularizer import append_regularization_ops
+from .core.program import default_startup_program, Variable
+from .core import unique_name
+from . import initializer as init_mod
+
+__all__ = [
+    "SGD", "Momentum", "Adagrad", "Adam", "Adamax", "DecayedAdagrad",
+    "Adadelta", "RMSProp", "Ftrl", "SGDOptimizer", "MomentumOptimizer",
+    "AdagradOptimizer", "AdamOptimizer", "AdamaxOptimizer",
+    "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
+    "FtrlOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, global_clip=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self.global_clip = global_clip
+        self._accumulators = {}
+        self._lr_var = None
+
+    # -- plumbing ----------------------------------------------------------
+    def _create_persistable(self, block, name, shape, dtype, init_value,
+                            startup_program=None):
+        sp = startup_program or default_startup_program()
+        var = block.create_var(
+            name=name, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=True,
+        )
+        sb = sp.global_block()
+        if name not in sb.vars:
+            svar = sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True)
+            init_mod.Constant(init_value)(svar, sb)
+        return var
+
+    def _create_lr_var(self, block, startup_program):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+        elif self._lr_var is None:
+            name = unique_name.generate("learning_rate")
+            self._lr_var = self._create_persistable(
+                block, name, [1], "float32", float(self._learning_rate),
+                startup_program,
+            )
+        return self._lr_var
+
+    def _param_lr(self, block, param):
+        scale = param.optimize_attr.get("learning_rate", 1.0)
+        if scale == 1.0:
+            return self._lr_var
+        out = Variable(
+            block, name=unique_name.generate(f"{param.name}.lr"),
+            shape=(1,), dtype="float32", stop_gradient=True,
+        )
+        block.vars[out.name] = out
+        block.append_op(
+            type="scale", inputs={"X": [self._lr_var.name]},
+            outputs={"Out": [out.name]}, attrs={"scale": float(scale)},
+        )
+        return out
+
+    def _add_accumulator(self, block, name, param, init_value=0.0, shape=None,
+                         startup_program=None):
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        var = self._create_persistable(
+            block, f"{param.name}_{name}", shape or list(param.shape),
+            "float32", init_value, startup_program,
+        )
+        self._accumulators[key] = var
+        return var
+
+    def _create_accumulators(self, block, parameters, startup_program):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- API ---------------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        block = loss.block.program.global_block()
+        self._startup = startup_program
+        params_grads = append_gradient_clip_ops(params_grads, self.global_clip)
+        params_grads = append_regularization_ops(params_grads, self.regularization)
+        self._create_lr_var(block, startup_program)
+        self._create_accumulators(
+            block, [p for p, _ in params_grads], startup_program
+        )
+        ops = [self._append_optimize_op(block, pg) for pg in params_grads]
+        return ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        return block.append_op(
+            type="sgd",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "LearningRate": [self._param_lr(block, param).name],
+            },
+            outputs={"ParamOut": [param.name]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters, sp):
+        for p in parameters:
+            self._add_accumulator(block, "velocity", p, startup_program=sp)
+
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        velocity = self._accumulators[("velocity", param.name)]
+        return block.append_op(
+            type="momentum",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Velocity": [velocity.name],
+                "LearningRate": [self._param_lr(block, param).name],
+            },
+            outputs={"ParamOut": [param.name], "VelocityOut": [velocity.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters, sp):
+        for p in parameters:
+            self._add_accumulator(block, "moment", p, startup_program=sp)
+
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        moment = self._accumulators[("moment", param.name)]
+        return block.append_op(
+            type="adagrad",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment": [moment.name],
+                "LearningRate": [self._param_lr(block, param).name],
+            },
+            outputs={"ParamOut": [param.name], "MomentOut": [moment.name]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters, sp):
+        for p in parameters:
+            self._add_accumulator(block, "moment1", p, startup_program=sp)
+            self._add_accumulator(block, "moment2", p, startup_program=sp)
+        self._beta1_pow = self._create_persistable(
+            block, unique_name.generate("beta1_pow_acc"), [1], "float32", 1.0, sp
+        )
+        self._beta2_pow = self._create_persistable(
+            block, unique_name.generate("beta2_pow_acc"), [1], "float32", 1.0, sp
+        )
+
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        m1 = self._accumulators[("moment1", param.name)]
+        m2 = self._accumulators[("moment2", param.name)]
+        return block.append_op(
+            type="adam",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment1": [m1.name],
+                "Moment2": [m2.name],
+                "LearningRate": [self._param_lr(block, param).name],
+                "Beta1Pow": [self._beta1_pow.name],
+                "Beta2Pow": [self._beta2_pow.name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "Moment1Out": [m1.name],
+                "Moment2Out": [m2.name],
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, pgs = super().minimize(loss, startup_program, parameter_list, no_grad_set)
+        # advance beta powers once per step (after all param updates)
+        block = loss.block.program.global_block()
+        block.append_op(
+            type="scale", inputs={"X": [self._beta1_pow.name]},
+            outputs={"Out": [self._beta1_pow.name]}, attrs={"scale": self._beta1},
+        )
+        block.append_op(
+            type="scale", inputs={"X": [self._beta2_pow.name]},
+            outputs={"Out": [self._beta2_pow.name]}, attrs={"scale": self._beta2},
+        )
+        return ops, pgs
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters, sp):
+        for p in parameters:
+            self._add_accumulator(block, "moment", p, startup_program=sp)
+            self._add_accumulator(block, "inf_norm", p, startup_program=sp)
+        self._beta1_pow = self._create_persistable(
+            block, unique_name.generate("beta1_pow_acc"), [1], "float32", 1.0, sp
+        )
+
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        moment = self._accumulators[("moment", param.name)]
+        inf_norm = self._accumulators[("inf_norm", param.name)]
+        return block.append_op(
+            type="adamax",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment": [moment.name],
+                "InfNorm": [inf_norm.name],
+                "LearningRate": [self._param_lr(block, param).name],
+                "Beta1Pow": [self._beta1_pow.name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "MomentOut": [moment.name],
+                "InfNormOut": [inf_norm.name],
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, pgs = super().minimize(loss, startup_program, parameter_list, no_grad_set)
+        block = loss.block.program.global_block()
+        block.append_op(
+            type="scale", inputs={"X": [self._beta1_pow.name]},
+            outputs={"Out": [self._beta1_pow.name]}, attrs={"scale": self._beta1},
+        )
+        return ops, pgs
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters, sp):
+        for p in parameters:
+            self._add_accumulator(block, "moment", p, startup_program=sp)
+
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        moment = self._accumulators[("moment", param.name)]
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment": [moment.name],
+                "LearningRate": [self._param_lr(block, param).name],
+            },
+            outputs={"ParamOut": [param.name], "MomentOut": [moment.name]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _create_accumulators(self, block, parameters, sp):
+        for p in parameters:
+            self._add_accumulator(block, "avg_squared_grad", p, startup_program=sp)
+            self._add_accumulator(block, "avg_squared_update", p, startup_program=sp)
+
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        asg = self._accumulators[("avg_squared_grad", param.name)]
+        asu = self._accumulators[("avg_squared_update", param.name)]
+        return block.append_op(
+            type="adadelta",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "AvgSquaredGrad": [asg.name],
+                "AvgSquaredUpdate": [asu.name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "AvgSquaredGradOut": [asg.name],
+                "AvgSquaredUpdateOut": [asu.name],
+            },
+            attrs={"rho": self._rho, "epsilon": self._epsilon},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum = rho, epsilon, momentum
+
+    def _create_accumulators(self, block, parameters, sp):
+        for p in parameters:
+            self._add_accumulator(block, "mean_square", p, startup_program=sp)
+            self._add_accumulator(block, "momentum", p, startup_program=sp)
+
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        ms = self._accumulators[("mean_square", param.name)]
+        mom = self._accumulators[("momentum", param.name)]
+        return block.append_op(
+            type="rmsprop",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "MeanSquare": [ms.name],
+                "Moment": [mom.name],
+                "LearningRate": [self._param_lr(block, param).name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "MeanSquareOut": [ms.name],
+                "MomentOut": [mom.name],
+            },
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters, sp):
+        for p in parameters:
+            self._add_accumulator(block, "squared", p, startup_program=sp)
+            self._add_accumulator(block, "linear", p, startup_program=sp)
+
+    def _append_optimize_op(self, block, pg):
+        param, grad = pg
+        sq = self._accumulators[("squared", param.name)]
+        lin = self._accumulators[("linear", param.name)]
+        return block.append_op(
+            type="ftrl",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "SquaredAccumulator": [sq.name],
+                "LinearAccumulator": [lin.name],
+                "LearningRate": [self._param_lr(block, param).name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "SquaredAccumOut": [sq.name],
+                "LinearAccumOut": [lin.name],
+            },
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+# v2-style short aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer

@@ -1,0 +1,33 @@
+"""Multi-device parallelism — the TPU-native replacement for the reference's
+entire distributed-compute stack (SURVEY §2.3/2.4):
+
+* MultiGradientMachine's per-GPU threads + ring gradient merge
+  (gserver/gradientmachines/MultiGradientMachine.h:52-79)   → batch-axis
+  sharding over a Mesh; XLA inserts the ICI all-reduce.
+* parallel_do_op's scatter/thread-pool/grad-sum (parallel_do_op.cc)
+  → the same sharding annotation; no scatter exists.
+* nccl_op allreduce/reduce/bcast (nccl_op.cu.cc)            → jax.lax.psum /
+  pmean etc. inside the compiled program.
+* ParallelNeuralNetwork per-layer device placement           → parameter
+  partition specs (tensor parallelism).
+* (NEW capability, absent in the 2018 reference) sequence/context
+  parallelism: ring attention over the sequence axis via shard_map +
+  ppermute.
+"""
+
+from .mesh import make_mesh, single_host_mesh
+from .api import (
+    compile_shardings,
+    data_parallel,
+    shard_parameter,
+    replicate,
+    P,
+)
+from .ring_attention import ring_attention, blockwise_attention
+from . import sparse
+
+__all__ = [
+    "make_mesh", "single_host_mesh", "compile_shardings", "data_parallel",
+    "shard_parameter", "replicate", "P", "ring_attention",
+    "blockwise_attention", "sparse",
+]

@@ -1,0 +1,36 @@
+"""Device mesh construction.
+
+Axis-name conventions used across the framework:
+  dp — data parallel (batch axis)        sp — sequence/context parallel
+  tp — tensor/model parallel             ep — expert parallel (reserved)
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(axes, devices=None):
+    """axes: dict name->size in the desired (major..minor) order, e.g.
+    {'dp': 4, 'tp': 2}.  Sizes must multiply to the device count; a size of
+    -1 is inferred."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def single_host_mesh(dp=-1, tp=1, sp=1):
+    """Convenience: all local devices in a dp×tp×sp mesh (dp inferred)."""
+    axes = {"dp": dp, "tp": tp, "sp": sp}
+    axes = {k: v for k, v in axes.items() if v != 1 or k == "dp"}
+    return make_mesh(axes)

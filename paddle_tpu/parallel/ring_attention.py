@@ -1,0 +1,131 @@
+"""Sequence/context parallelism: ring attention.
+
+The reference predates attention sharding entirely (SURVEY §2.3: "TP / PP /
+CP / ring-attention: ABSENT"); its long-sequence story was LoD batching.
+This module supplies the missing capability TPU-natively: the sequence axis
+is sharded over a mesh axis ('sp'), each device holds a Q/K/V block, and K/V
+blocks rotate around the ring via ``jax.lax.ppermute`` while a numerically
+stable online-softmax accumulates partial attention — compute overlaps the
+ICI transfer, memory per device is O(T/sp).
+
+Also provides single-device blockwise attention (the memory-efficient
+flash-style loop via lax.scan) used as the inner kernel.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _attn_block(q, k, v, bias=None, scale=None):
+    """One dense block: returns (unnormalized out, row logsumexp-style stats).
+    q [b, tq, h, d], k/v [b, tk, h, d]."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        logits = logits + bias
+    m = jnp.max(logits, axis=-1, keepdims=True)  # [b,h,q,1]
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o, m[..., 0], l[..., 0]  # o [b,q,h,d], m/l [b,h,q]
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Merge two partial attention results with online softmax."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    cast = lambda x: jnp.swapaxes(x, 1, 2)[..., None]  # [b,h,q]->[b,q,h,1]
+    o = o1 * cast(a1).astype(o1.dtype) + o2 * cast(a2).astype(o2.dtype)
+    return o, m, l
+
+
+def _finalize(o, m, l):
+    return o / jnp.swapaxes(l, 1, 2)[..., None].astype(o.dtype)
+
+
+def blockwise_attention(q, k, v, block_size=512, causal=False):
+    """Memory-efficient attention on one device: scan over K/V blocks with
+    online softmax; peak memory O(tq * block) instead of O(tq * tk)."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    nblk = max(tk // block_size, 1)
+    while tk % nblk:  # tk must split evenly; shrink block count until it does
+        nblk -= 1
+    bs = tk // nblk
+    kb = k.reshape(b, nblk, bs, h, d)
+    vb = v.reshape(b, nblk, bs, h, d)
+
+    def body(carry, blk):
+        o, m, l = carry
+        kk, vv, idx = blk
+        bias = None
+        if causal:
+            qpos = jnp.arange(tq)[:, None]
+            kpos = idx * bs + jnp.arange(bs)[None, :]
+            bias = jnp.where(qpos >= kpos, 0.0, -1e30)[None, None]
+        o2, m2, l2 = _attn_block(q, kk, vv, bias=bias)
+        return _merge(o, m, l, o2, m2, l2), None
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((b, h, tq), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    (o, m, l), _ = jax.lax.scan(
+        body,
+        (o0, m0, l0),
+        (jnp.swapaxes(kb, 0, 1), jnp.swapaxes(vb, 0, 1), jnp.arange(nblk)),
+    )
+    return _finalize(o, m, l)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False):
+    """Ring attention over a sequence-sharded batch.
+
+    q/k/v: [b, t, h, d] GLOBALLY, sharded on t over ``axis_name``.  Must be
+    called under the mesh (the function shard_maps itself).  Returns output
+    sharded the same way.
+    """
+    sp = mesh.shape[axis_name]
+
+    def local_fn(q_blk, k_blk, v_blk):
+        # q_blk etc: [b, t/sp, h, d] local shards
+        b, tl, h, d = q_blk.shape
+        my_idx = jax.lax.axis_index(axis_name)
+
+        def step(carry, i):
+            o, m, l, kk, vv = carry
+            src_idx = (my_idx - i) % sp  # whose K/V block we hold now
+            bias = None
+            if causal:
+                qpos = (my_idx * tl + jnp.arange(tl))[:, None]
+                kpos = (src_idx * tl + jnp.arange(tl))[None, :]
+                bias = jnp.where(qpos >= kpos, 0.0, -1e30)[None, None]
+            o2, m2, l2 = _attn_block(q_blk, kk, vv, bias=bias)
+            o, m, l = _merge(o, m, l, o2, m2, l2)
+            # rotate K/V around the ring (overlaps with next block's compute)
+            perm = [(j, (j + 1) % sp) for j in range(sp)]
+            kk = jax.lax.ppermute(kk, axis_name, perm)
+            vv = jax.lax.ppermute(vv, axis_name, perm)
+            return (o, m, l, kk, vv), None
+
+        o0 = jnp.zeros_like(q_blk)
+        m0 = jnp.full((b, h, tl), -1e30, jnp.float32)
+        l0 = jnp.zeros((b, h, tl), jnp.float32)
+        (o, m, l, _, _), _ = jax.lax.scan(
+            step, (o0, m0, l0, k_blk, v_blk), jnp.arange(sp)
+        )
+        return _finalize(o, m, l)
+
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

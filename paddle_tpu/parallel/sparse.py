@@ -1,0 +1,45 @@
+"""Sparse / embedding parallelism.
+
+Reference: SelectedRows sparse grads (framework/selected_rows.h), row-sparse
+parameters (math/SparseRowMatrix), SparseRemoteParameterUpdater
+(RemoteParameterUpdater.h:265) and the pserver sparse modes
+(ParameterService.proto:40 GET_PARAM_SPARSE).  On-pod equivalent: row-shard
+the table over a mesh axis and let GSPMD turn lookups into a one-hot
+matmul/all-gather of just the touched rows; cross-pod (DCN) equivalent lives
+in paddle_tpu.distributed.pserver (async sparse updates).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .api import shard_parameter
+
+
+def row_shard_embedding(param, mesh_axis="tp"):
+    """Annotate an embedding table [vocab, dim] as row-sharded: each device
+    owns vocab/axis_size contiguous rows."""
+    return shard_parameter(param, P(mesh_axis, None))
+
+
+def sparse_rows_from_grad(grad, ids, vocab_size):
+    """Compress a dense embedding gradient into SelectedRows form
+    (rows, values) — the wire format the distributed pserver path sends over
+    DCN instead of the full table (reference SelectedRows / sparse update
+    protocol)."""
+    flat_ids = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    uniq, inv = jnp.unique(
+        flat_ids, return_inverse=True, size=flat_ids.shape[0], fill_value=-1
+    )
+    g = jnp.reshape(grad, (flat_ids.shape[0], -1))
+    values = jnp.zeros((uniq.shape[0], g.shape[1]), g.dtype).at[inv].add(g)
+    return uniq, values
+
+
+def apply_sparse_rows(table, rows, values, lr):
+    """SGD apply of SelectedRows onto a dense table (pserver-side
+    doOperation analog for the sparse path)."""
+    valid = rows >= 0
+    safe_rows = jnp.where(valid, rows, 0)
+    update = jnp.where(valid[:, None], values * lr, 0.0)
+    return table.at[safe_rows].add(-update)

@@ -1,0 +1,37 @@
+"""ParamAttr — per-parameter configuration (reference:
+python/paddle/v2/fluid/param_attr.py): name, initializer, learning rate
+scale, regularizer, trainability, gradient clip."""
+
+from . import initializer as init_mod
+
+
+class ParamAttr:
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        gradient_clip=None,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.gradient_clip = gradient_clip
+
+    @staticmethod
+    def to_attr(arg):
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, init_mod.Initializer):
+            return ParamAttr(initializer=arg)
+        if isinstance(arg, bool):
+            return ParamAttr() if arg else None  # False means "no bias"
+        raise TypeError(f"cannot convert {arg!r} to ParamAttr")

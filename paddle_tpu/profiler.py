@@ -1,0 +1,74 @@
+"""Profiling & debugging hooks.
+
+Reference: platform/profiler.{h,cc} (RecordEvent around every op in the
+Executor, aggregated table via ParseEvents/PrintProfiler), fluid/profiler.py
+(cuda_profiler → nvprof), utils/Stat.h REGISTER_TIMER, and the
+FLAGS_check_nan_inf per-op scan (executor.cc:131).
+
+On TPU the op loop is compiled away, so per-op host timers are meaningless;
+the equivalents are: (1) the JAX/XLA profiler producing XPlane traces viewed
+in TensorBoard/xprof (``profiler('dir')``), (2) named host-side timers for
+the train loop (``timer`` / ``print_profiler``), and (3) jax debug_nans as
+the check_nan_inf analog (``nan_guard``)."""
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+_records = defaultdict(lambda: [0.0, 0])
+
+
+@contextlib.contextmanager
+def timer(name):
+    """REGISTER_TIMER analog for host-side phases."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _records[name][0] += dt
+        _records[name][1] += 1
+
+
+def reset_profiler():
+    _records.clear()
+
+
+def print_profiler(sorted_key="total"):
+    """PrintProfiler analog: aggregated host timer table."""
+    rows = [
+        (name, total, calls, total / max(calls, 1))
+        for name, (total, calls) in _records.items()
+    ]
+    key = {"total": 1, "calls": 2, "ave": 3}.get(sorted_key, 1)
+    rows.sort(key=lambda r: -r[key])
+    out = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Ave(s)':>12}"]
+    for name, total, calls, ave in rows:
+        out.append(f"{name:<40}{calls:>8}{total:>12.4f}{ave:>12.6f}")
+    table = "\n".join(out)
+    print(table)
+    return table
+
+
+@contextlib.contextmanager
+def profiler(log_dir="/tmp/paddle_tpu_profile", state=None):
+    """Device-level tracing (fluid profiler.py analog): XPlane trace for
+    xprof/TensorBoard instead of nvprof output."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def nan_guard():
+    """FLAGS_check_nan_inf analog: raise on NaN in any jitted computation."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)

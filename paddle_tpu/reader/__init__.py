@@ -1,0 +1,26 @@
+"""Composable data readers.
+
+Reference: python/paddle/v2/reader — a reader is a no-arg callable returning
+an iterable of samples; decorators compose them (decorator.py: map_readers,
+shuffle, batched/batch, buffered, compose, chain, firstn, xmap_readers,
+pipe_reader; creator.py: np_array, text_file, recordio, cloud_reader).
+Identical protocol here — it is pure Python and already the right shape for
+feeding an async device pipeline.
+"""
+
+from .decorator import (
+    map_readers,
+    buffered,
+    compose,
+    chain,
+    shuffle,
+    firstn,
+    xmap_readers,
+    batch,
+)
+from . import creator
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
+    "xmap_readers", "batch", "creator",
+]

@@ -1,0 +1,60 @@
+"""Reader creators (reference: python/paddle/v2/reader/creator.py —
+np_array, text_file, recordio, cloud_reader via Go master)."""
+
+import numpy as np
+
+__all__ = ["np_array", "text_file", "recordio", "cloud_reader"]
+
+
+def np_array(x):
+    def reader():
+        for e in np.asarray(x):
+            yield e
+
+    return reader
+
+
+def text_file(path):
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Read record files written by paddle_tpu.dataset.common.convert (a
+    simple length-prefixed record format standing in for RecordIO)."""
+    from ..dataset.common import read_records
+    import pickle
+
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        for p in paths:
+            for rec in read_records(p):
+                yield pickle.loads(rec)
+
+    return reader
+
+
+def cloud_reader(paths, etcd_endpoints=None, timeout_sec=5, buf_size=64):
+    """Elastic dataset reader backed by the distributed master service
+    (reference creator.py:91 cloud_reader → Go master).  Pulls task chunks
+    from paddle_tpu.distributed.master.MasterClient."""
+    import pickle
+
+    from ..distributed.master import MasterClient
+
+    def reader():
+        client = MasterClient(etcd_endpoints, timeout_sec=timeout_sec)
+        client.set_dataset(paths)
+        while True:
+            rec = client.next_record()
+            if rec is None:
+                break
+            yield pickle.loads(rec)
+
+    return reader

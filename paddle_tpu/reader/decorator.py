@@ -1,0 +1,183 @@
+"""Reader decorators (reference: python/paddle/v2/reader/decorator.py)."""
+
+import itertools
+import random
+import queue as queue_mod
+import threading
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
+    "xmap_readers", "batch",
+]
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment=True):
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(map(make_tuple, outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned"
+                    )
+                yield sum(map(make_tuple, outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch into a bounded queue on a daemon thread — the analog of the
+    reference's double-buffered PyDataProvider2 pool."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = queue_mod.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in r:
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (decorator.py
+    xmap_readers)."""
+
+    end = object()
+
+    def data_reader():
+        in_q = queue_mod.Queue(buffer_size)
+        out_q = queue_mod.Queue(buffer_size)
+
+        def read_worker():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def map_worker():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, d = item
+                out_q.put((i, mapper(d)))
+
+        threading.Thread(target=read_worker, daemon=True).start()
+        workers = [
+            threading.Thread(target=map_worker, daemon=True)
+            for _ in range(process_num)
+        ]
+        for w in workers:
+            w.start()
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=True):
+    """Group samples into minibatches (reference: paddle/v2/minibatch.py).
+    ``drop_last`` defaults True for TPU: a ragged final batch would trigger
+    a recompile for one step."""
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,78 @@
+"""Weight-decay regularizers (reference: fluid/regularizer.py — append_
+regularization_ops adds the penalty gradient to each param's grad op-side)."""
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    """For each (param, grad): grad += reg_grad(param).  Appended after the
+    backward marker so the ops run with @GRAD vars live (reference
+    regularizer.py pattern)."""
+    params_and_grads = []
+    for param, grad in parameters_and_grads:
+        regularizer = getattr(param, "regularizer", None) or regularization
+        if regularizer is None:
+            params_and_grads.append((param, grad))
+            continue
+        block = param.block
+        new_grad = regularizer._append_ops(param, grad, block)
+        params_and_grads.append((param, new_grad))
+    return params_and_grads
+
+
+class WeightDecayRegularizer:
+    def _append_ops(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append_ops(self, param, grad, block):
+        from .core import unique_name
+        from .core.program import Variable
+
+        decay = Variable(
+            block, name=unique_name.generate(f"{param.name}.l2decay"),
+            shape=param.shape, dtype=param.dtype, stop_gradient=True,
+        )
+        block.vars[decay.name] = decay
+        block.append_op(
+            type="scale", inputs={"X": [param.name]},
+            outputs={"Out": [decay.name]}, attrs={"scale": self._coeff},
+        )
+        block.append_op(
+            type="sum", inputs={"X": [grad.name, decay.name]},
+            outputs={"Out": [grad.name]},
+        )
+        return grad
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append_ops(self, param, grad, block):
+        from .core import unique_name
+        from .core.program import Variable
+
+        sign = Variable(
+            block, name=unique_name.generate(f"{param.name}.l1sign"),
+            shape=param.shape, dtype=param.dtype, stop_gradient=True,
+        )
+        block.vars[sign.name] = sign
+        block.append_op(
+            type="sign", inputs={"X": [param.name]}, outputs={"Out": [sign.name]}
+        )
+        block.append_op(
+            type="scale", inputs={"X": [sign.name]}, outputs={"Out": [sign.name]},
+            attrs={"scale": self._coeff},
+        )
+        block.append_op(
+            type="sum", inputs={"X": [grad.name, sign.name]},
+            outputs={"Out": [grad.name]},
+        )
+        return grad
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

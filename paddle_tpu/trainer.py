@@ -1,0 +1,122 @@
+"""v2-style training driver with events.
+
+Reference: python/paddle/v2/trainer.py:37 SGD (train:137 — pass loop,
+batch loop, event_handler callbacks) + python/paddle/v2/event.py and the
+C++ pass driver paddle/trainer/Trainer.cpp:265/496.  The event-handler
+pattern is preserved exactly; the body of a step is one jitted program run.
+"""
+
+import time
+
+import numpy as np
+
+from .core.executor import Executor
+from .core.program import default_main_program, default_startup_program
+from .core.scope import global_scope
+from .data_feeder import DataFeeder
+from . import profiler as _profiler
+from . import io as _io
+
+
+# -- events (reference: python/paddle/v2/event.py) --------------------------
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass:
+    def __init__(self, pass_id, evaluator_results=None):
+        self.pass_id = pass_id
+        self.evaluator_results = evaluator_results
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration:
+    def __init__(self, pass_id, batch_id, cost, metrics):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.metrics = metrics
+
+
+class Trainer:
+    """Drive a built program: pass/batch loops, events, checkpointing.
+
+    cost: the loss Variable (the program must already contain optimize ops —
+    build with optimizer.minimize(cost) before constructing the Trainer).
+    """
+
+    def __init__(self, cost, feed_list, place=None, extra_fetch=None,
+                 main_program=None, startup_program=None, mesh=None):
+        self.cost = cost
+        self.feed_list = feed_list
+        self.main_program = main_program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.exe = Executor(place, mesh=mesh)
+        self.feeder = DataFeeder(feed_list, place)
+        self.extra_fetch = extra_fetch or []
+        self._initialized = False
+
+    def init_params(self):
+        self.exe.run(self.startup_program)
+        self._initialized = True
+
+    def train(self, reader, num_passes=1, event_handler=None,
+              checkpoint_dir=None, checkpoint_every_n_passes=1):
+        if not self._initialized:
+            self.init_params()
+        event_handler = event_handler or (lambda e: None)
+        fetch = [self.cost] + list(self.extra_fetch)
+        for pass_id in range(num_passes):
+            event_handler(BeginPass(pass_id))
+            for batch_id, batch in enumerate(reader()):
+                event_handler(BeginIteration(pass_id, batch_id))
+                with _profiler.timer("train_batch"):
+                    vals = self.exe.run(
+                        self.main_program,
+                        feed=self.feeder.feed(batch),
+                        fetch_list=fetch,
+                    )
+                cost = float(np.asarray(vals[0]).reshape(-1)[0])
+                metrics = [np.asarray(v) for v in vals[1:]]
+                event_handler(EndIteration(pass_id, batch_id, cost, metrics))
+            if checkpoint_dir and (pass_id + 1) % checkpoint_every_n_passes == 0:
+                _io.save_persistables(
+                    self.exe, f"{checkpoint_dir}/pass_{pass_id}", self.main_program
+                )
+            event_handler(EndPass(pass_id))
+
+    def test(self, reader, test_program=None, fetch_list=None):
+        """Average fetched values over a test reader (reference
+        Tester.cpp / v2 SGD.test)."""
+        program = test_program or self.main_program.clone(for_test=True)
+        fetch = fetch_list or [self.cost]
+        totals = None
+        n = 0
+        for batch in reader():
+            vals = self.exe.run(
+                program, feed=self.feeder.feed(batch), fetch_list=fetch
+            )
+            vals = [np.asarray(v, dtype=np.float64) for v in vals]
+            totals = vals if totals is None else [t + v for t, v in zip(totals, vals)]
+            n += 1
+        if totals is None:
+            return []
+        return [t / n for t in totals]
+
+    def save_checkpoint(self, dirname):
+        _io.save_persistables(self.exe, dirname, self.main_program)
+
+    def load_checkpoint(self, dirname):
+        if not self._initialized:
+            self.init_params()
+        _io.load_persistables(self.exe, dirname, self.main_program)
+
+
+# v2 API name
+SGD = Trainer

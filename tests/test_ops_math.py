@@ -1,0 +1,128 @@
+"""Op tests for the math group — check_output vs numpy + check_grad
+(analytic vs numeric), mirroring fluid's per-op test files (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_output, check_grad, run_op
+
+rng = np.random.RandomState(42)
+
+
+def test_elementwise_add_broadcast_axis():
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    y = rng.randn(3).astype(np.float32)
+    check_output(
+        "elementwise_add", {"X": x, "Y": y},
+        {"Out": x + y.reshape(1, 3, 1)}, attrs={"axis": 1},
+    )
+
+
+def test_elementwise_ops_trailing_broadcast():
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(5).astype(np.float32)
+    check_output("elementwise_mul", {"X": x, "Y": y}, {"Out": x * y})
+    check_output("elementwise_sub", {"X": x, "Y": y}, {"Out": x - y})
+    check_output("elementwise_max", {"X": x, "Y": y}, {"Out": np.maximum(x, y)})
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("elementwise_add", lambda x, y: x + y),
+    ("elementwise_mul", lambda x, y: x * y),
+    ("elementwise_div", lambda x, y: x / y),
+])
+def test_elementwise_grad(op, ref):
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    y = rng.rand(3, 4).astype(np.float32) + 0.5
+    check_grad(op, {"X": x, "Y": y}, "X")
+    check_grad(op, {"X": x, "Y": y}, "Y")
+
+
+def test_mul_flatten():
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    y = rng.randn(12, 5).astype(np.float32)
+    out = x.reshape(2, 12) @ y
+    check_output(
+        "mul", {"X": x, "Y": y}, {"Out": out.reshape(2, 5)},
+        attrs={"x_num_col_dims": 1, "y_num_col_dims": 1},
+    )
+    check_grad("mul", {"X": x, "Y": y}, "X")
+    check_grad("mul", {"X": x, "Y": y}, "Y")
+
+
+def test_matmul_transpose():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(5, 4).astype(np.float32)
+    check_output(
+        "matmul", {"X": x, "Y": y}, {"Out": x @ y.T},
+        attrs={"transpose_Y": True}, atol=1e-4,
+    )
+    check_grad("matmul", {"X": x, "Y": y}, "X", attrs={"transpose_Y": True})
+
+
+def test_sum_multiple_inputs():
+    xs = [rng.randn(2, 3).astype(np.float32) for _ in range(3)]
+    check_output("sum", {"X": xs}, {"Out": xs[0] + xs[1] + xs[2]})
+
+
+def test_reduce_ops():
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    check_output("reduce_sum", {"X": x}, {"Out": x.sum(1)}, attrs={"dim": 1})
+    check_output(
+        "reduce_mean", {"X": x}, {"Out": x.mean((0, 2), keepdims=True)},
+        attrs={"dim": [0, 2], "keep_dim": True},
+    )
+    check_output("reduce_max", {"X": x}, {"Out": x.max()}, attrs={"reduce_all": True})
+    check_grad("reduce_sum", {"X": x}, "X", attrs={"dim": 1})
+    check_grad("reduce_mean", {"X": x}, "X", attrs={"dim": [0, 2]})
+
+
+def test_scale_clip_sign():
+    x = rng.randn(3, 3).astype(np.float32)
+    check_output("scale", {"X": x}, {"Out": x * 2.0 + 1.0},
+                 attrs={"scale": 2.0, "bias": 1.0})
+    check_output("clip", {"X": x}, {"Out": np.clip(x, -0.5, 0.5)},
+                 attrs={"min": -0.5, "max": 0.5})
+    check_output("sign", {"X": x}, {"Out": np.sign(x)})
+
+
+def test_clip_by_norm():
+    x = (rng.randn(4, 4) * 10).astype(np.float32)
+    norm = np.sqrt((x ** 2).sum())
+    check_output("clip_by_norm", {"X": x}, {"Out": x * (1.0 / norm)},
+                 attrs={"max_norm": 1.0}, atol=1e-4)
+
+
+def test_cos_sim():
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randn(4, 8).astype(np.float32)
+    expected = (x * y).sum(1) / (
+        np.linalg.norm(x, axis=1) * np.linalg.norm(y, axis=1)
+    )
+    got = run_op("cos_sim", {"X": x, "Y": y})
+    np.testing.assert_allclose(got["Out"].reshape(-1), expected, rtol=1e-4)
+    check_grad("cos_sim", {"X": x, "Y": y}, "X", max_relative_error=1e-2)
+
+
+def test_activations_match_numpy():
+    x = rng.randn(3, 4).astype(np.float32)
+    check_output("sigmoid", {"X": x}, {"Out": 1 / (1 + np.exp(-x))}, atol=1e-5)
+    check_output("tanh", {"X": x}, {"Out": np.tanh(x)})
+    check_output("relu", {"X": x}, {"Out": np.maximum(x, 0)})
+    check_output("square", {"X": x}, {"Out": x * x})
+    check_output("leaky_relu", {"X": x},
+                 {"Out": np.where(x > 0, x, 0.02 * x)}, attrs={"alpha": 0.02})
+
+
+@pytest.mark.parametrize("op", ["sigmoid", "tanh", "softplus", "swish", "elu"])
+def test_activation_grads(op):
+    x = rng.randn(3, 4).astype(np.float32)
+    check_grad(op, {"X": x}, "X")
+
+
+def test_softmax_and_grad():
+    x = rng.randn(4, 7).astype(np.float32)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    check_output("softmax", {"X": x}, {"Out": e / e.sum(-1, keepdims=True)}, atol=1e-5)
+    check_grad("softmax", {"X": x}, "X",
+               loss_weights=rng.rand(4, 7).astype(np.float32))

@@ -1,0 +1,197 @@
+"""Op tests: conv / pool / norm / losses (reference test_conv2d_op.py,
+test_pool2d_op.py, test_batch_norm_op.py, test_cross_entropy_op.py …)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_output, check_grad, run_op
+
+rng = np.random.RandomState(7)
+
+
+def _conv2d_ref(x, w, stride, pad):
+    n, cin, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride : i * stride + kh, j * stride : j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def test_conv2d_vs_reference_impl():
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    expected = _conv2d_ref(x, w, 1, 1)
+    check_output(
+        "conv2d", {"Input": x, "Filter": w}, {"Output": expected},
+        attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+               "groups": 1},
+        atol=1e-3, rtol=1e-3,
+    )
+
+
+def test_conv2d_grad():
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1], "groups": 1}
+    check_grad("conv2d", {"Input": x, "Filter": w}, "Input", attrs=attrs,
+               output="Output", max_relative_error=1e-2)
+    check_grad("conv2d", {"Input": x, "Filter": w}, "Filter", attrs=attrs,
+               output="Output", max_relative_error=1e-2)
+
+
+def test_depthwise_conv2d_shape():
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    w = rng.randn(4, 1, 3, 3).astype(np.float32)
+    out = run_op("depthwise_conv2d", {"Input": x, "Filter": w},
+                 {"strides": [1, 1], "paddings": [1, 1]})
+    assert out["Output"].shape == (2, 4, 8, 8)
+
+
+def test_conv2d_transpose_shape():
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    w = rng.randn(3, 5, 2, 2).astype(np.float32)
+    out = run_op("conv2d_transpose", {"Input": x, "Filter": w},
+                 {"strides": [2, 2], "paddings": [0, 0]})
+    assert out["Output"].shape == (2, 5, 8, 8)
+
+
+def test_pool2d_max_avg():
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    out = run_op("pool2d", {"X": x},
+                 {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                  "pooling_type": "max"})
+    expected = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out["Out"], expected, rtol=1e-6)
+    out = run_op("pool2d", {"X": x},
+                 {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                  "pooling_type": "avg"})
+    expected = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(out["Out"], expected, rtol=1e-5)
+
+
+def test_pool2d_grad():
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+             "pooling_type": "max"}
+    check_grad("pool2d", {"X": x}, "X", attrs=attrs, max_relative_error=1e-2)
+
+
+def test_batch_norm_train_stats():
+    x = rng.randn(4, 3, 5, 5).astype(np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    out = run_op(
+        "batch_norm",
+        {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+        {"momentum": 0.9, "epsilon": 1e-5, "is_test": False},
+    )
+    y = out["Y"]
+    # normalized output has ~zero mean, ~unit variance per channel
+    np.testing.assert_allclose(y.mean(axis=(0, 2, 3)), 0, atol=1e-5)
+    np.testing.assert_allclose(y.var(axis=(0, 2, 3)), 1, atol=1e-3)
+    batch_mean = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(
+        out["MeanOut"], 0.9 * mean + 0.1 * batch_mean, atol=1e-5
+    )
+
+
+def test_batch_norm_is_test_uses_running_stats():
+    x = rng.randn(4, 3, 2, 2).astype(np.float32)
+    mean = rng.randn(3).astype(np.float32)
+    var = np.abs(rng.randn(3)).astype(np.float32) + 0.5
+    out = run_op(
+        "batch_norm",
+        {"X": x, "Scale": np.ones(3, np.float32), "Bias": np.zeros(3, np.float32),
+         "Mean": mean, "Variance": var},
+        {"is_test": True},
+    )
+    expected = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1) + 1e-5
+    )
+    np.testing.assert_allclose(out["Y"], expected, atol=1e-4)
+
+
+def test_layer_norm():
+    x = rng.randn(4, 10).astype(np.float32)
+    out = run_op("layer_norm", {"X": x}, {"begin_norm_axis": 1})
+    np.testing.assert_allclose(out["Y"].mean(1), 0, atol=1e-5)
+    np.testing.assert_allclose(out["Y"].std(1), 1, atol=1e-3)
+
+
+def test_cross_entropy():
+    p = np.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32)
+    lbl = np.asarray([[0], [1]], np.int64)
+    expected = -np.log(np.asarray([[0.7], [0.8]], np.float32))
+    check_output("cross_entropy", {"X": p, "Label": lbl}, {"Y": expected},
+                 atol=1e-5)
+    check_grad("cross_entropy", {"X": p, "Label": lbl}, "X", output="Y")
+
+
+def test_softmax_with_cross_entropy_matches_composition():
+    logits = rng.randn(4, 6).astype(np.float32)
+    lbl = rng.randint(0, 6, (4, 1)).astype(np.int64)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    expected = -np.log(np.take_along_axis(sm, lbl, 1))
+    got = run_op("softmax_with_cross_entropy", {"Logits": logits, "Label": lbl})
+    np.testing.assert_allclose(got["Loss"], expected, atol=1e-5)
+    check_grad("softmax_with_cross_entropy", {"Logits": logits, "Label": lbl},
+               "Logits", output="Loss")
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = rng.randn(3, 4).astype(np.float32)
+    z = rng.randint(0, 2, (3, 4)).astype(np.float32)
+    expected = np.maximum(x, 0) - x * z + np.log1p(np.exp(-np.abs(x)))
+    check_output("sigmoid_cross_entropy_with_logits", {"X": x, "Label": z},
+                 {"Out": expected}, atol=1e-5)
+
+
+def test_smooth_l1_and_huber():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    got = run_op("smooth_l1_loss", {"X": x, "Y": y}, {"sigma": 1.0})
+    d = x - y
+    ad = np.abs(d)
+    loss = np.where(ad < 1, 0.5 * d * d, ad - 0.5).sum(1, keepdims=True)
+    np.testing.assert_allclose(got["Out"], loss, rtol=1e-5)
+    check_grad("smooth_l1_loss", {"X": x, "Y": y}, "X")
+
+
+def test_lrn_shape_and_grad():
+    x = rng.randn(2, 8, 4, 4).astype(np.float32)
+    out = run_op("lrn", {"X": x}, {"n": 5})
+    assert out["Out"].shape == x.shape
+    check_grad("lrn", {"X": x}, "X", attrs={"n": 5}, max_relative_error=1e-2)
+
+
+def test_maxout():
+    x = rng.randn(2, 6, 3, 3).astype(np.float32)
+    out = run_op("maxout", {"X": x}, {"groups": 2})
+    expected = x.reshape(2, 3, 2, 3, 3).max(2)
+    np.testing.assert_allclose(out["Out"], expected)
+
+
+def test_im2sequence():
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    out = run_op("im2sequence", {"X": x},
+                 {"kernels": [2, 2], "strides": [2, 2], "paddings": [0, 0, 0, 0]})
+    assert out["Out"].shape == (2, 4, 12)
+
+
+def test_row_conv_masks_tail():
+    x = rng.randn(2, 6, 4).astype(np.float32)
+    f = rng.randn(3, 4).astype(np.float32)
+    lens = np.asarray([4, 6], np.int32)
+    out = run_op("row_conv", {"X": x, "Filter": f, "Length": lens})["Out"]
+    assert np.all(out[0, 4:] == 0)
+    expected_00 = (x[0, 0] * f[0] + x[0, 1] * f[1] + x[0, 2] * f[2])
+    np.testing.assert_allclose(out[0, 0], expected_00, rtol=1e-5)

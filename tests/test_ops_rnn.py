@@ -1,0 +1,97 @@
+"""Op tests: LSTM/GRU family — shape, mask-freezing, gradient checks
+(reference: test_lstm_op.py, test_gru_op.py, gserver test_LayerGrad RNN
+suites)."""
+
+import numpy as np
+
+from op_test import check_grad, run_op
+
+rng = np.random.RandomState(5)
+
+
+def test_lstm_shapes_and_mask():
+    b, t, d = 2, 5, 3
+    x = rng.randn(b, t, 4 * d).astype(np.float32)
+    w = (rng.randn(d, 4 * d) * 0.1).astype(np.float32)
+    lens = np.asarray([3, 5], np.int32)
+    got = run_op("lstm", {"Input": x, "Weight": w, "Length": lens})
+    assert got["Hidden"].shape == (b, t, d)
+    # hidden state frozen after sequence end
+    np.testing.assert_allclose(got["Hidden"][0, 2], got["Hidden"][0, 3])
+    np.testing.assert_allclose(got["Hidden"][0, 3], got["Hidden"][0, 4])
+
+
+def test_lstm_reverse_runs_backward():
+    b, t, d = 1, 4, 2
+    x = rng.randn(b, t, 4 * d).astype(np.float32)
+    w = (rng.randn(d, 4 * d) * 0.1).astype(np.float32)
+    fwd = run_op("lstm", {"Input": x, "Weight": w})["Hidden"]
+    rev = run_op("lstm", {"Input": x, "Weight": w}, {"is_reverse": True})["Hidden"]
+    # reverse of reversed input equals forward on reversed sequence
+    fwd_flip = run_op("lstm", {"Input": x[:, ::-1], "Weight": w})["Hidden"]
+    np.testing.assert_allclose(rev, fwd_flip[:, ::-1], rtol=1e-5)
+
+
+def test_lstm_grad():
+    b, t, d = 2, 3, 2
+    x = rng.randn(b, t, 4 * d).astype(np.float32)
+    w = (rng.randn(d, 4 * d) * 0.2).astype(np.float32)
+    lens = np.asarray([2, 3], np.int32)
+    check_grad("lstm", {"Input": x, "Weight": w, "Length": lens}, "Input",
+               output="Hidden", max_relative_error=1e-2)
+    check_grad("lstm", {"Input": x, "Weight": w, "Length": lens}, "Weight",
+               output="Hidden", max_relative_error=1e-2)
+
+
+def test_lstm_peephole_bias():
+    b, t, d = 1, 3, 2
+    x = rng.randn(b, t, 4 * d).astype(np.float32)
+    w = (rng.randn(d, 4 * d) * 0.2).astype(np.float32)
+    bias = (rng.randn(1, 7 * d) * 0.1).astype(np.float32)
+    got = run_op("lstm", {"Input": x, "Weight": w, "Bias": bias},
+                 {"use_peepholes": True})
+    assert got["Hidden"].shape == (b, t, d)
+
+
+def test_gru_shapes_mask_and_grad():
+    b, t, d = 2, 4, 3
+    x = rng.randn(b, t, 3 * d).astype(np.float32)
+    w = (rng.randn(d, 3 * d) * 0.2).astype(np.float32)
+    lens = np.asarray([2, 4], np.int32)
+    got = run_op("gru", {"Input": x, "Weight": w, "Length": lens})
+    assert got["Hidden"].shape == (b, t, d)
+    np.testing.assert_allclose(got["Hidden"][0, 1], got["Hidden"][0, 3])
+    check_grad("gru", {"Input": x, "Weight": w, "Length": lens}, "Input",
+               output="Hidden", max_relative_error=1e-2)
+
+
+def test_lstmp_projection_shape():
+    b, t, d, p = 2, 3, 4, 2
+    x = rng.randn(b, t, 4 * d).astype(np.float32)
+    w = (rng.randn(p, 4 * d) * 0.2).astype(np.float32)
+    pw = (rng.randn(d, p) * 0.2).astype(np.float32)
+    got = run_op("lstmp", {"Input": x, "Weight": w, "ProjWeight": pw})
+    assert got["Projection"].shape == (b, t, p)
+
+
+def test_lstm_unit_matches_manual():
+    b, d = 2, 3
+    x = rng.randn(b, 4 * d).astype(np.float32)
+    c = rng.randn(b, d).astype(np.float32)
+    got = run_op("lstm_unit", {"X": x, "C_prev": c})
+    gi, gf, gc, go = np.split(x, 4, axis=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    c_new = sig(gf) * c + sig(gi) * np.tanh(gc)
+    h_new = sig(go) * np.tanh(c_new)
+    np.testing.assert_allclose(got["C"], c_new, rtol=1e-5)
+    np.testing.assert_allclose(got["H"], h_new, rtol=1e-5)
+
+
+def test_gru_unit_step_equals_full_gru_first_step():
+    b, d = 2, 3
+    x = rng.randn(b, 3 * d).astype(np.float32)
+    w = (rng.randn(d, 3 * d) * 0.2).astype(np.float32)
+    h0 = np.zeros((b, d), np.float32)
+    unit = run_op("gru_unit", {"Input": x, "HiddenPrev": h0, "Weight": w})
+    full = run_op("gru", {"Input": x[:, None, :], "Weight": w})
+    np.testing.assert_allclose(unit["Hidden"], full["Hidden"][:, 0], rtol=1e-5)

@@ -1,0 +1,193 @@
+"""Op tests: the sequence group — the LoD-replacement semantics (padded +
+lengths) must reproduce the reference's ragged behavior."""
+
+import numpy as np
+import pytest
+
+from op_test import check_output, check_grad, run_op
+
+rng = np.random.RandomState(3)
+
+
+def test_sequence_pool_types():
+    x = rng.randn(2, 4, 3).astype(np.float32)
+    lens = np.asarray([2, 4], np.int32)
+    got = run_op("sequence_pool", {"X": x, "Length": lens}, {"pooltype": "SUM"})
+    np.testing.assert_allclose(got["Out"][0], x[0, :2].sum(0), rtol=1e-5)
+    np.testing.assert_allclose(got["Out"][1], x[1].sum(0), rtol=1e-5)
+    got = run_op("sequence_pool", {"X": x, "Length": lens}, {"pooltype": "AVERAGE"})
+    np.testing.assert_allclose(got["Out"][0], x[0, :2].mean(0), rtol=1e-5)
+    got = run_op("sequence_pool", {"X": x, "Length": lens}, {"pooltype": "MAX"})
+    np.testing.assert_allclose(got["Out"][0], x[0, :2].max(0), rtol=1e-5)
+    got = run_op("sequence_pool", {"X": x, "Length": lens}, {"pooltype": "LAST"})
+    np.testing.assert_allclose(got["Out"][0], x[0, 1], rtol=1e-5)
+    got = run_op("sequence_pool", {"X": x, "Length": lens}, {"pooltype": "FIRST"})
+    np.testing.assert_allclose(got["Out"][1], x[1, 0], rtol=1e-5)
+
+
+def test_sequence_pool_grad_masked():
+    x = rng.randn(2, 4, 3).astype(np.float32)
+    lens = np.asarray([2, 3], np.int32)
+    check_grad("sequence_pool", {"X": x, "Length": lens}, "X",
+               attrs={"pooltype": "SUM"})
+
+
+def test_sequence_softmax_masked():
+    x = rng.randn(2, 5).astype(np.float32)
+    lens = np.asarray([3, 5], np.int32)
+    got = run_op("sequence_softmax", {"X": x, "Length": lens})["Out"]
+    np.testing.assert_allclose(got[0, :3].sum(), 1.0, rtol=1e-5)
+    assert np.all(got[0, 3:] == 0)
+    e = np.exp(x[0, :3] - x[0, :3].max())
+    np.testing.assert_allclose(got[0, :3], e / e.sum(), rtol=1e-5)
+
+
+def test_sequence_conv_context():
+    x = rng.randn(1, 4, 2).astype(np.float32)
+    f = rng.randn(6, 3).astype(np.float32)  # context 3 * dim 2
+    lens = np.asarray([4], np.int32)
+    got = run_op(
+        "sequence_conv", {"X": x, "Filter": f, "Length": lens},
+        {"contextLength": 3, "contextStart": -1},
+    )["Out"]
+    # position 0: context rows [-1 (zero), 0, 1]
+    ctx0 = np.concatenate([np.zeros(2, np.float32), x[0, 0], x[0, 1]])
+    np.testing.assert_allclose(got[0, 0], ctx0 @ f, rtol=1e-4)
+
+
+def test_sequence_expand():
+    x = rng.randn(2, 3).astype(np.float32)
+    y = rng.randn(2, 4, 5).astype(np.float32)
+    ylen = np.asarray([2, 4], np.int32)
+    got = run_op("sequence_expand", {"X": x, "Y": y, "YLength": ylen})["Out"]
+    assert got.shape == (2, 4, 3)
+    np.testing.assert_allclose(got[0, 0], x[0])
+    np.testing.assert_allclose(got[0, 1], x[0])
+    assert np.all(got[0, 2:] == 0)
+
+
+def test_sequence_erase_and_ctc_align():
+    x = np.asarray([[1, 1, 0, 2, 2, 0, 3, 0]], np.int64)
+    lens = np.asarray([8], np.int32)
+    got = run_op("ctc_align", {"Input": x, "Length": lens},
+                 {"blank": 0, "merge_repeated": True})
+    np.testing.assert_array_equal(got["Output"][0, :3], [1, 2, 3])
+    assert got["OutputLength"][0] == 3
+
+    got = run_op("sequence_erase", {"X": x, "Length": lens}, {"tokens": [0, 1]})
+    np.testing.assert_array_equal(got["Out"][0, :3], [2, 2, 3])
+    assert got["OutLength"][0] == 3
+
+
+def test_edit_distance():
+    hyp = np.asarray([[1, 2, 3, 0]], np.int64)
+    ref = np.asarray([[1, 3, 3, 4]], np.int64)
+    got = run_op(
+        "edit_distance",
+        {"Hyps": hyp, "Refs": ref,
+         "HypsLength": np.asarray([3], np.int32),
+         "RefsLength": np.asarray([4], np.int32)},
+    )
+    # kitten-style: [1,2,3] vs [1,3,3,4] = sub(2->3)? dist: 1 sub + 1 ins = 2
+    assert got["Out"][0, 0] == 2.0
+
+
+def test_warpctc_loss_and_grad():
+    b, t, v, l = 2, 6, 5, 2
+    logits = rng.randn(b, t, v).astype(np.float32)
+    labels = np.asarray([[1, 2], [3, 0]], np.int64)
+    lab_len = np.asarray([2, 1], np.int32)
+    log_len = np.asarray([6, 4], np.int32)
+    got = run_op(
+        "warpctc",
+        {"Logits": logits, "Label": labels, "LogitsLength": log_len,
+         "LabelLength": lab_len},
+        {"blank": 0},
+    )
+    assert got["Loss"].shape == (2, 1)
+    assert np.all(got["Loss"] > 0)
+    check_grad(
+        "warpctc",
+        {"Logits": logits, "Label": labels, "LogitsLength": log_len,
+         "LabelLength": lab_len},
+        "Logits", attrs={"blank": 0}, output="Loss", max_relative_error=1e-2,
+    )
+
+
+def test_ctc_loss_simple_case():
+    """T=1, single label: loss = -log softmax(logits)[label]."""
+    logits = rng.randn(1, 1, 4).astype(np.float32)
+    labels = np.asarray([[2]], np.int64)
+    got = run_op(
+        "warpctc",
+        {"Logits": logits, "Label": labels,
+         "LogitsLength": np.asarray([1], np.int32),
+         "LabelLength": np.asarray([1], np.int32)},
+        {"blank": 0},
+    )
+    e = np.exp(logits[0, 0] - logits[0, 0].max())
+    expected = -np.log(e[2] / e.sum())
+    np.testing.assert_allclose(got["Loss"][0, 0], expected, rtol=1e-4)
+
+
+def test_linear_chain_crf_uniform_is_log_numtags():
+    """Zero emissions+transitions: nll = T * 0 ... = log(paths)."""
+    b, t, n = 1, 3, 4
+    em = np.zeros((b, t, n), np.float32)
+    trans = np.zeros((n + 2, n), np.float32)
+    lbl = np.zeros((b, t), np.int64)
+    lens = np.asarray([t], np.int32)
+    got = run_op(
+        "linear_chain_crf",
+        {"Emission": em, "Transition": trans, "Label": lbl, "Length": lens},
+    )
+    np.testing.assert_allclose(
+        got["LogLikelihood"][0, 0], t * np.log(n), rtol=1e-5
+    )
+
+
+def test_crf_decoding_picks_best_path():
+    n = 3
+    em = np.asarray([[[5, 0, 0], [0, 5, 0], [0, 0, 5]]], np.float32)
+    trans = np.zeros((n + 2, n), np.float32)
+    got = run_op(
+        "crf_decoding",
+        {"Emission": em, "Transition": trans,
+         "Length": np.asarray([3], np.int32)},
+    )
+    np.testing.assert_array_equal(got["ViterbiPath"][0], [0, 1, 2])
+
+
+def test_crf_grad():
+    b, t, n = 2, 4, 3
+    em = rng.randn(b, t, n).astype(np.float32)
+    trans = rng.randn(n + 2, n).astype(np.float32) * 0.1
+    lbl = rng.randint(0, n, (b, t)).astype(np.int64)
+    lens = np.asarray([3, 4], np.int32)
+    check_grad(
+        "linear_chain_crf",
+        {"Emission": em, "Transition": trans, "Label": lbl, "Length": lens},
+        "Emission", output="LogLikelihood", max_relative_error=1e-2,
+    )
+    check_grad(
+        "linear_chain_crf",
+        {"Emission": em, "Transition": trans, "Label": lbl, "Length": lens},
+        "Transition", output="LogLikelihood", max_relative_error=1e-2,
+    )
+
+
+def test_chunk_eval_iob():
+    # tags: B-0=0, I-0=1, O=2 (num_chunk_types=1)
+    inf = np.asarray([[0, 1, 2, 0, 2]], np.int64)
+    lab = np.asarray([[0, 1, 2, 0, 2]], np.int64)
+    got = run_op("chunk_eval", {"Inference": inf, "Label": lab},
+                 {"num_chunk_types": 1, "chunk_scheme": "IOB"})
+    assert got["NumInferChunks"][0] == 2
+    assert got["NumLabelChunks"][0] == 2
+    assert got["NumCorrectChunks"][0] == 2
+    np.testing.assert_allclose(got["F1-Score"][0], 1.0)
+    # now a partial match: second chunk extends
+    inf2 = np.asarray([[0, 1, 2, 0, 1]], np.int64)
+    got = run_op("chunk_eval", {"Inference": inf2, "Label": lab},
+                 {"num_chunk_types": 1, "chunk_scheme": "IOB"})
+    assert got["NumCorrectChunks"][0] == 1

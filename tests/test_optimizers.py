@@ -1,0 +1,100 @@
+"""Every optimizer converges on a quadratic (reference: per-optimizer op
+tests + FirstOrderOptimizer unit tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run_optimizer(opt, steps=60):
+    x = layers.data("x", shape=[4])
+    pred = layers.fc(input=x, size=1, bias_attr=False,
+                     param_attr=pt.initializer.Constant(2.0))
+    loss = layers.mean(layers.square(pred))
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    data = np.ones((8, 4), np.float32)
+    losses = []
+    for _ in range(steps):
+        (l,) = exe.run(feed={"x": data}, fetch_list=[loss])
+        losses.append(float(l[0]))
+    return losses
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda: pt.optimizer.SGD(learning_rate=0.01),
+    lambda: pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9),
+    lambda: pt.optimizer.Adagrad(learning_rate=0.5),
+    lambda: pt.optimizer.Adam(learning_rate=0.3),
+    lambda: pt.optimizer.Adamax(learning_rate=0.3),
+    lambda: pt.optimizer.DecayedAdagrad(learning_rate=0.3),
+    lambda: pt.optimizer.Adadelta(learning_rate=1.0, rho=0.5, epsilon=1e-2),
+    lambda: pt.optimizer.RMSProp(learning_rate=0.1),
+    lambda: pt.optimizer.Ftrl(learning_rate=0.5),
+])
+def test_optimizer_decreases_loss(make_opt):
+    losses = _run_optimizer(make_opt())
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_weight_decay_shrinks_weights():
+    x = layers.data("x", shape=[4])
+    pred = layers.fc(
+        input=x, size=1, bias_attr=False,
+        param_attr=pt.ParamAttr(
+            initializer=pt.initializer.Constant(1.0),
+            regularizer=pt.regularizer.L2Decay(0.5),
+        ),
+    )
+    loss = layers.mean(pred) * 0.0  # zero data gradient; only decay acts
+    loss = layers.mean(loss)
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    wname = [n for n in scope.var_names() if n.endswith(".w")][0]
+    exe.run(feed={"x": np.zeros((2, 4), np.float32)}, fetch_list=[loss])
+    w = np.asarray(scope.get(wname))
+    np.testing.assert_allclose(w, 0.95 * np.ones_like(w), rtol=1e-5)
+
+
+def test_global_norm_clip():
+    x = layers.data("x", shape=[4])
+    pred = layers.fc(input=x, size=1, bias_attr=False,
+                     param_attr=pt.initializer.Constant(1.0))
+    loss = layers.mean(pred)
+    opt = pt.optimizer.SGD(
+        learning_rate=1.0,
+        global_clip=pt.clip.GradientClipByGlobalNorm(0.001),
+    )
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    wname = [n for n in scope.var_names() if n.endswith(".w")][0]
+    w0 = np.asarray(scope.get(wname)).copy()
+    exe.run(feed={"x": np.ones((2, 4), np.float32) * 100}, fetch_list=[loss])
+    w1 = np.asarray(scope.get(wname))
+    # update magnitude bounded by clip norm
+    assert np.abs(w1 - w0).sum() < 0.01
+
+
+def test_lr_decay_schedule():
+    lr = pt.learning_rate_decay.exponential_decay(
+        learning_rate=1.0, decay_steps=1, decay_rate=0.5
+    )
+    x = layers.data("x", shape=[2])
+    pred = layers.fc(input=x, size=1, bias_attr=False,
+                     param_attr=pt.initializer.Constant(1.0))
+    loss = layers.mean(pred)
+    pt.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    vals = []
+    for _ in range(3):
+        (v,) = exe.run(feed={"x": np.ones((2, 2), np.float32)}, fetch_list=[lr])
+        vals.append(float(v[0]))
+    np.testing.assert_allclose(vals, [0.5, 0.25, 0.125], rtol=1e-5)
