@@ -1,0 +1,81 @@
+"""Benchmark: ResNet-50 training throughput on the available accelerator.
+
+Flagship = BASELINE config 2 (reference model config
+``benchmark/paddle/image/resnet.py``; reference CPU number: 81.69 img/s
+train bs64 on 2x Xeon 6148, ``benchmark/IntelOptimizedPaddle.md:39-45``).
+The north-star target is 3000 img/s on a v5e-16 slice => 187.5 img/s/chip;
+``vs_baseline`` reports measured img/s/chip against that per-chip target.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+
+    n_chips = max(len(jax.devices()), 1)
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        outs = resnet.build(depth=50, class_dim=1000,
+                            image_shape=(3, 224, 224), dtype="bfloat16")
+
+    mesh = None
+    if n_chips > 1:
+        from paddle_tpu.parallel.mesh import make_mesh
+        from paddle_tpu.parallel import api as papi
+
+        mesh = make_mesh({"dp": n_chips})
+        papi.data_parallel(main_prog, "dp", programs=(startup,))
+        batch *= n_chips
+
+    exe = pt.Executor(mesh=mesh)
+    exe.run(startup)
+
+    import jax.numpy as jnp
+
+    # Device-resident synthetic batch: benchmarks the training step, not the
+    # host->device pipe (real input pipelines prefetch to device).
+    img = np.random.rand(batch, 3, 224, 224)
+    label = np.random.randint(0, 1000, (batch, 1))
+    if mesh is None:
+        img = jax.device_put(jnp.asarray(img, dtype=jnp.bfloat16))
+        label = jax.device_put(jnp.asarray(label, dtype=jnp.int64))
+    feed = {"img": img, "label": label}
+    fetch = [outs["avg_cost"]]
+
+    for _ in range(warmup):
+        cost = exe.run(main_prog, feed=feed, fetch_list=fetch)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        cost = exe.run(main_prog, feed=feed, fetch_list=fetch)
+    # fetches are numpy already (device sync happened)
+    dt = time.perf_counter() - t0
+
+    img_per_s = batch * steps / dt
+    per_chip = img_per_s / n_chips
+    target_per_chip = 3000.0 / 16.0
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / target_per_chip, 3),
+    }))
+    assert np.isfinite(np.asarray(cost[0])).all()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

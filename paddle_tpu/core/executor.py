@@ -142,10 +142,17 @@ class Executor:
         block = program.global_block()
         feed_vals = []
         for n in feed_names:
+            val = feed[n]
             var = block._find_var(n)
             dtype = var.dtype if var is not None else None
-            arr = np.asarray(feed[n], dtype=dtype)
-            feed_vals.append(arr)
+            if isinstance(val, jax.Array):
+                # already device-resident (e.g. a prefetched pipeline) —
+                # no host round-trip; coerce dtype on device if needed.
+                if dtype is not None and val.dtype != dtype:
+                    val = val.astype(dtype)
+                feed_vals.append(val)
+            else:
+                feed_vals.append(np.asarray(val, dtype=dtype))
 
         state_names = tuple(
             sorted(
@@ -179,7 +186,12 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
-    def _compile(self, program, feed_names, fetch_names, state_names):
+    def lower(self, program, feed_names, fetch_names, state_names):
+        """Build the pure (unjitted) step function
+        ``step(state, *feed) -> (new_state, fetches)`` for a program.
+        Returns ``(step, persist_out)`` where persist_out names the state
+        entries the step emits.  Exposed for embedding the framework in
+        external jit pipelines (e.g. the driver's compile checks)."""
         block = program.global_block()
         bw = block.backward_index
         info = program._backward_info.get(0)
@@ -232,6 +244,11 @@ class Executor:
             fetches = tuple(env[n] for n in fetch_names)
             return new_state, fetches
 
+        return step, persist_out
+
+    def _compile(self, program, feed_names, fetch_names, state_names):
+        step, persist_out = self.lower(
+            program, feed_names, fetch_names, state_names)
         jit_kwargs = {}
         if self.donate_state:
             jit_kwargs["donate_argnums"] = 0

@@ -302,13 +302,19 @@ class Program:
     def clone(self, for_test=False):
         """Deep copy; ``for_test=True`` flips ``is_test`` attrs (the analog
         of the reference's inference_optimize, pybind.cc:299)."""
-        p = copy.deepcopy(self)
+        p = copy.deepcopy(self)  # fresh _serial via __setstate__
         if for_test:
             for blk in p.blocks:
                 for op in blk.ops:
                     if "is_test" in op.attrs:
                         op.attrs["is_test"] = True
         return p
+
+    def __setstate__(self, state):
+        # fresh identity on deepcopy/unpickle: the Executor caches compiled
+        # steps keyed on (_serial, _version); a copy must never collide.
+        self.__dict__.update(state)
+        self._serial = next(_program_serial)
 
     def prune(self, targets):
         """Backward-slice the global block to ops needed for ``targets``

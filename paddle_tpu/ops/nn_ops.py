@@ -17,8 +17,9 @@ from ..core.registry import register_op
 _CONV_DN = ("NCHW", "OIHW", "NCHW")
 
 
-def _acc(x):
-    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+# NOTE: no preferred_element_type on convs — jax's conv transpose (grad)
+# rule mis-types the cotangent when output dtype != input dtype, and the TPU
+# MXU accumulates bf16 convs in float32 natively anyway.
 
 
 def _pair(v, n=2):
@@ -38,7 +39,6 @@ def conv2d(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(1, 1), gro
         rhs_dilation=d,
         dimension_numbers=_CONV_DN,
         feature_group_count=groups,
-        preferred_element_type=_acc(Input),
     )
     return {"Output": out.astype(Input.dtype)}
 
@@ -60,8 +60,9 @@ def conv2d_transpose(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(
     s, p, d = _pair(strides), _pair(paddings), _pair(dilations)
     w = jnp.swapaxes(Filter.astype(Input.dtype), 0, 1)[:, :, ::-1, ::-1]
     kh, kw = w.shape[2], w.shape[3]
-    pad_h = kh - 1 - p[0]
-    pad_w = kw - 1 - p[1]
+    # transpose-conv implicit padding on the dilated kernel extent
+    pad_h = d[0] * (kh - 1) - p[0]
+    pad_w = d[1] * (kw - 1) - p[1]
     out = jax.lax.conv_general_dilated(
         Input,
         w,
@@ -70,7 +71,6 @@ def conv2d_transpose(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(
         lhs_dilation=s,
         rhs_dilation=d,
         dimension_numbers=_CONV_DN,
-        preferred_element_type=_acc(Input),
     )
     return {"Output": out.astype(Input.dtype)}
 
@@ -86,7 +86,6 @@ def conv3d(Input, Filter, strides=(1, 1, 1), paddings=(0, 0, 0), dilations=(1, 1
         rhs_dilation=d,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         feature_group_count=groups,
-        preferred_element_type=_acc(Input),
     )
     return {"Output": out.astype(Input.dtype)}
 
@@ -177,10 +176,19 @@ def max_pool2d_with_index(X, ksize=(2, 2), strides=(1, 1), paddings=(0, 0), glob
 
 
 @register_op("unpool")
-def unpool(X, Indices, ksize=(2, 2), strides=(2, 2), paddings=(0, 0), unpooling_type="max", **_):
+def unpool(X, Indices, ksize=(2, 2), strides=(2, 2), paddings=(0, 0),
+           unpooling_type="max", output_size=None, **_):
     n, c, h, w = X.shape
-    s = _pair(strides)
-    oh, ow = h * s[0], w * s[1]
+    s, k, p = _pair(strides), _pair(ksize), _pair(paddings)
+    if output_size is not None:
+        # explicit original extent (the pooled-shape formula floors, so it
+        # is not invertible when windows didn't tile exactly)
+        oh, ow = output_size
+    else:
+        # invert the pooled-shape formula: Mask holds flat positions in the
+        # ORIGINAL map, so the output must be that original extent
+        oh = (h - 1) * s[0] + k[0] - 2 * p[0]
+        ow = (w - 1) * s[1] + k[1] - 2 * p[1]
     flat = jnp.zeros((n, c, oh * ow), dtype=X.dtype)
     idx = Indices.reshape(n, c, -1).astype(jnp.int32)
     vals = X.reshape(n, c, -1)
@@ -228,7 +236,8 @@ def batch_norm(
         saved_mean, saved_var = Mean, Variance
     else:
         mean = jnp.mean(xf, axis=axes)
-        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        # centered form: E[x^2]-E[x]^2 can cancel to a negative in f32
+        var = jnp.mean(jnp.square(xf - mean.reshape(bshape)), axis=axes)
         mean_out = (momentum * Mean.astype(jnp.float32) + (1 - momentum) * mean).astype(Mean.dtype)
         var_out = (momentum * Variance.astype(jnp.float32) + (1 - momentum) * var).astype(Variance.dtype)
         saved_mean, saved_var = mean, var

@@ -195,3 +195,53 @@ def test_row_conv_masks_tail():
     assert np.all(out[0, 4:] == 0)
     expected_00 = (x[0, 0] * f[0] + x[0, 1] * f[1] + x[0, 2] * f[2])
     np.testing.assert_allclose(out[0, 0], expected_00, rtol=1e-5)
+
+
+def test_conv2d_transpose_dilated_shape():
+    # regression: implicit padding must use the DILATED kernel extent
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 4, 3, 3).astype(np.float32)
+    out = run_op("conv2d_transpose", {"Input": x, "Filter": w},
+                 {"strides": [1, 1], "paddings": [0, 0], "dilations": [2, 2]})
+    # oh = (i-1)*s - 2p + (k-1)*d + 1 = 4 + 4 + 1 = 9
+    assert out["Output"].shape == (1, 4, 9, 9)
+
+
+def test_unpool_roundtrip_overlapping_window():
+    # regression: unpool must invert the ORIGINAL extent, incl. ksize!=stride
+    x = rng.randn(1, 2, 9, 9).astype(np.float32)
+    pooled = run_op("max_pool2d_with_index", {"X": x},
+                    {"ksize": [3, 3], "strides": [2, 2], "paddings": [0, 0]})
+    up = run_op("unpool", {"X": pooled["Out"], "Indices": pooled["Mask"]},
+                {"ksize": [3, 3], "strides": [2, 2], "paddings": [0, 0]})
+    assert up["Out"].shape == (1, 2, 9, 9)
+    # every pooled max value must land somewhere in the unpooled map
+    for nmax in np.asarray(pooled["Out"]).reshape(2, -1).max(axis=1):
+        assert nmax in np.asarray(up["Out"])
+
+
+def test_batch_norm_large_mean_no_nan():
+    # regression: E[x^2]-E[x]^2 cancellation produced negative variance
+    x = (rng.randn(4, 3, 2, 2) * 1e-3 + 500.0).astype(np.float32)
+    out = run_op(
+        "batch_norm",
+        {"X": x, "Scale": np.ones(3, np.float32),
+         "Bias": np.zeros(3, np.float32),
+         "Mean": np.zeros(3, np.float32),
+         "Variance": np.ones(3, np.float32)},
+        {"is_test": False})
+    assert np.isfinite(np.asarray(out["Y"])).all()
+
+
+def test_unpool_explicit_output_size():
+    # non-tiling input: 10x10 with k3/s2 pools to 4x4 and is only exactly
+    # invertible via output_size
+    x = rng.randn(1, 1, 10, 10).astype(np.float32)
+    x[0, 0, 8, 8] = 100.0
+    pooled = run_op("max_pool2d_with_index", {"X": x},
+                    {"ksize": [3, 3], "strides": [2, 2], "paddings": [0, 0]})
+    up = run_op("unpool", {"X": pooled["Out"], "Indices": pooled["Mask"]},
+                {"ksize": [3, 3], "strides": [2, 2], "paddings": [0, 0],
+                 "output_size": [10, 10]})
+    assert up["Out"].shape == (1, 1, 10, 10)
+    assert np.asarray(up["Out"])[0, 0, 8, 8] == 100.0
