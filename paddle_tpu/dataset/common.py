@@ -4,7 +4,6 @@ download with md5, converter to RecordIO)."""
 import hashlib
 import os
 import pickle
-import struct
 
 DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
 
@@ -36,22 +35,21 @@ def download(url, module_name, md5sum=None):
     )
 
 
-# -- simple length-prefixed record file (RecordIO stand-in) -----------------
-def write_records(path, records):
-    with open(path, "wb") as f:
+# -- recordio-backed record files (chunked, CRC-checked; native C++ codec
+# with pure-Python fallback — paddle_tpu/native/src/recordio.cc) ------------
+def write_records(path, records, compressor=0, max_chunk_bytes=1 << 20):
+    from ..native import recordio
+
+    with recordio.Writer(path, compressor=compressor,
+                         max_chunk_bytes=max_chunk_bytes) as w:
         for rec in records:
-            f.write(struct.pack("<Q", len(rec)))
-            f.write(rec)
+            w.write(rec)
 
 
 def read_records(path):
-    with open(path, "rb") as f:
-        while True:
-            hdr = f.read(8)
-            if len(hdr) < 8:
-                return
-            (n,) = struct.unpack("<Q", hdr)
-            yield f.read(n)
+    from ..native import recordio
+
+    yield from recordio.reader(path)
 
 
 def convert(output_path, reader, line_count, name_prefix):

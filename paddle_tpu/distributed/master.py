@@ -81,15 +81,25 @@ class MasterService:
 
     # -- RPC surface -------------------------------------------------------
     def set_dataset(self, chunk_paths):
-        """Partition chunk files into tasks (service.go partition:106).
+        """Partition recordio files into chunk-granular tasks
+        (service.go partition:106 — one task = chunks_per_task chunks).
         First caller wins; later calls are no-ops (matching the reference)."""
+        from ..native import recordio
+
         with self._lock:
             if self._dataset_set:
                 return self._pass_id
-            paths = sorted(chunk_paths)
-            for i in range(0, len(paths), self.chunks_per_task):
+            chunks = []
+            for p in sorted(chunk_paths):
+                try:
+                    for off, _cnt in recordio.index(p):
+                        chunks.append([p, int(off)])
+                except IOError:
+                    # not a recordio file: whole file = one chunk
+                    chunks.append([p, -1])
+            for i in range(0, len(chunks), self.chunks_per_task):
                 self.todo.append(
-                    Task(str(uuid.uuid4()), paths[i : i + self.chunks_per_task])
+                    Task(str(uuid.uuid4()), chunks[i : i + self.chunks_per_task])
                 )
             self._dataset_set = True
             self._snapshot()
@@ -183,7 +193,9 @@ class MasterClient:
             self._call = self._client.call
         self._task = None
         self._records = iter(())
-        self._exhausted = False
+        self._pass_id = 0
+        self._pending_task = None  # task leased across a pass boundary
+        self._signaled_boundary = False
 
     def set_dataset(self, chunk_paths):
         self._call("set_dataset", list(chunk_paths))
@@ -198,22 +210,45 @@ class MasterClient:
 
     def next_record(self):
         """One record, leasing tasks as needed (client.go:244 NextRecord).
-        Returns None when the current pass is exhausted."""
+        Returns None when the current pass is exhausted; subsequent calls
+        continue into the next pass (per-pass queues, service.go GetTask)."""
         while True:
             try:
-                return next(self._records)
+                rec = next(self._records)
+                self._signaled_boundary = False
+                return rec
             except StopIteration:
                 pass
             if self._task is not None:
                 self._call("task_finished", self._task["id"])
                 self._task = None
-            task = self._next_task()
+            if self._pending_task is not None:
+                task, self._pending_task = self._pending_task, None
+            else:
+                task = self._next_task()
             if task is None:
+                self._signaled_boundary = True
                 return None
+            if task.get("pass_id", 0) != self._pass_id:
+                self._pass_id = task.get("pass_id", 0)
+                if not self._signaled_boundary:
+                    # pass boundary: hold the lease, signal end-of-pass ONCE
+                    # (a timeout-None may already have signaled this boundary
+                    # — don't produce a phantom empty pass)
+                    self._pending_task = task
+                    self._signaled_boundary = True
+                    return None
+                # boundary already reported via a timeout-None: continue
 
             def gen(paths):
-                for p in paths:
-                    yield from read_records(p)
+                from ..native import recordio
+
+                for entry in paths:
+                    p, off = entry if isinstance(entry, (list, tuple)) else (entry, -1)
+                    if off < 0:
+                        yield from read_records(p)
+                    else:
+                        yield from recordio.read_chunk(p, off)
 
             self._task = task
             self._records = gen(task["paths"])

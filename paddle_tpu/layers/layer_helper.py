@@ -45,6 +45,31 @@ class LayerHelper:
         if attr is None:
             return None
         name = attr.name or f"{self.name}.{suffix}"
+        # Explicitly-named parameters are shared across layers (the reference
+        # reuses the variable when two layers name the same ParamAttr, e.g.
+        # word2vec's shared embedding table).
+        gb = self.main_program.global_block()
+        if attr.name is not None and attr.name in gb.vars:
+            from ..core.program import Parameter
+            from ..core.dtypes import convert_dtype
+
+            existing = gb.vars[attr.name]
+            if not isinstance(existing, Parameter):
+                raise ValueError(
+                    f"param_attr name {attr.name!r} collides with a "
+                    f"non-parameter variable"
+                )
+            if tuple(existing.shape) != tuple(shape):
+                raise ValueError(
+                    f"shared parameter {attr.name!r} reused with shape "
+                    f"{tuple(shape)} != existing {tuple(existing.shape)}"
+                )
+            if existing.dtype != convert_dtype(dtype):
+                raise ValueError(
+                    f"shared parameter {attr.name!r} reused with dtype "
+                    f"{dtype} != existing {existing.dtype.name}"
+                )
+            return existing
         init = attr.initializer or default_initializer
         if init is None:
             if suffix == "b":

@@ -43,6 +43,7 @@ __all__ = [
     "smooth_l1",
     "matmul",
     "mul",
+    "flash_attention",
     "topk",
     "warpctc",
     "ctc_greedy_decoder",
@@ -83,10 +84,12 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     helper = LayerHelper("fc", bias_attr=bias_attr, act=act, name=name, **kwargs)
     inputs = input if isinstance(input, (list, tuple)) else [input]
     mul_results = []
-    for x in inputs:
+    for i, x in enumerate(inputs):
         in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        # one weight per input (duplicable W slot); w_0, w_1... when several
         w = helper.create_parameter(
-            param_attr, shape=[in_dim, size], dtype=x.dtype, suffix="w"
+            param_attr, shape=[in_dim, size], dtype=x.dtype,
+            suffix="w" if len(inputs) == 1 else f"w_{i}",
         )
         out_shape = list(x.shape[:num_flatten_dims]) + [size]
         tmp = helper.create_tmp_variable(x.dtype, out_shape, lod_level=x.lod_level)
@@ -751,6 +754,22 @@ def softmax(x, name=None):
     helper = LayerHelper("softmax", name=name)
     out = helper.create_tmp_variable(x.dtype, list(x.shape))
     helper.append_op(type="softmax", inputs={"X": [x.name]}, outputs={"Out": [out.name]})
+    return out
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
+    """Fused blockwise attention (Pallas TPU kernel,
+    ops/pallas_attention.py).  q [b, t_q, h, d], k/v [b, t_k, h, d] ->
+    [b, t_q, h, d]."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype, q.shape)
+    helper.append_op(
+        type="flash_attention",
+        inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
+        outputs={"Out": [out.name]},
+        attrs={"causal": bool(causal),
+               "sm_scale": 0.0 if sm_scale is None else float(sm_scale)},
+    )
     return out
 
 

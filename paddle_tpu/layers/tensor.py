@@ -134,7 +134,12 @@ def zeros(shape, dtype="float32"):
 
 def reshape(x, shape):
     helper = LayerHelper("reshape")
-    out = helper.create_tmp_variable(x.dtype, shape)
+    # 0 = copy dim from input (Paddle reshape convention)
+    out_shape = [
+        x.shape[i] if s == 0 and i < len(x.shape) else s
+        for i, s in enumerate(shape)
+    ]
+    out = helper.create_tmp_variable(x.dtype, out_shape)
     helper.append_op(
         type="reshape", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
         attrs={"shape": list(shape)},

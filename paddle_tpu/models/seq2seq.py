@@ -56,7 +56,7 @@ def build(src_dict_size=30000, trg_dict_size=30000, word_dim=256,
     layers.link_sequence(prediction, trg)
     # masked token-level cross entropy over the padded batch
     cost = layers.cross_entropy(input=prediction, label=trg_next)
-    cost = layers.reshape(cost, [cost.shape[0], -1])
+    cost = layers.reshape(cost, [0, -1])
     layers.link_sequence(cost, trg)
     summed = layers.sequence_pool(cost, pool_type="sum")
     avg_cost = layers.mean(summed)
@@ -91,8 +91,15 @@ def build_decode(src_dict_size=30000, trg_dict_size=30000, word_dim=256,
     )
     counter = layers.zeros([1], "int64")
     cond = layers.fill_constant([1], "bool", 1.0)
-    ids_array = cf.create_array("int64", max_out_len, [batch, beam_size])
-    parents_array = cf.create_array("int64", max_out_len, [batch, beam_size])
+    # arrays [t, b, k] — batch dim taken from the (runtime) batch size
+    ids_array = layers.fill_constant_batch_size_like(
+        init_state, [max_out_len, 1, beam_size], "int64", 0.0,
+        output_dim_idx=1,
+    )
+    parents_array = layers.fill_constant_batch_size_like(
+        init_state, [max_out_len, 1, beam_size], "int64", 0.0,
+        output_dim_idx=1,
+    )
     # replicate decoder state across beams: [b, k, h]
     state = layers.expand(
         layers.reshape(init_state, [batch, 1, hidden_dim]), [1, beam_size, 1]
@@ -100,7 +107,10 @@ def build_decode(src_dict_size=30000, trg_dict_size=30000, word_dim=256,
 
     w = cf.While(cond)
     with w.block():
-        flat_state = layers.reshape(state, [batch * beam_size, hidden_dim])
+        flat_state = layers.reshape(
+            state,
+            [batch * beam_size if batch > 0 else -1, hidden_dim],
+        )
         context = nets.simple_attention(
             _tile_seq(enc, beam_size), _tile_seq(enc_proj, beam_size),
             flat_state, hidden_dim,
@@ -163,6 +173,15 @@ def _tile_seq(x, k):
         out.block.vars[out.name + "@LENGTH"] = tiled
         out.lod_level = x.lod_level
     return out
+
+
+def _gather_beams(x, parents):
+    """Regroup [b, k, d] by parent beam indices [b, k]:
+    out[b, i] = x[b, parents[b, i]] — expressed as onehot(parents) @ x so it
+    stays a dense MXU matmul instead of a gather."""
+    k = x.shape[1]
+    onehot = layers.one_hot(parents, k)  # [b, k, k] float32
+    return layers.matmul(layers.cast(onehot, x.dtype), x)
 
 
 def _beam_embedding(pre_ids, dict_size, word_dim):

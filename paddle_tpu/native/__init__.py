@@ -1,0 +1,200 @@
+"""paddle_tpu.native — C++ runtime components (ctypes bindings).
+
+The reference implements its runtime in native code (allocator
+``paddle/memory``, data providers ``paddle/gserver/dataproviders``, RecordIO
+chunk partitioning in ``go/master``).  On TPU the *compute* path is XLA, but
+the host-side runtime around it is native here too:
+
+* ``recordio``   — chunked, CRC-checked record file format (writer/reader/
+                   chunk index) — storage layer for datasets and the
+                   distributed master's task partitioning.
+* ``Loader``     — multithreaded prefetching record loader with a bounded
+                   queue (the PyDataProvider2 background-thread pattern,
+                   without the GIL in the IO path).
+* ``BuddyAllocator`` — power-of-two buddy arena for host staging buffers
+                   (paddle/memory/detail/buddy_allocator analog).
+
+If no C++ toolchain is available the recordio format falls back to a pure-
+Python implementation (same on-disk bytes); Loader/BuddyAllocator then
+raise on construction.
+"""
+
+import ctypes
+import os
+
+from . import build as _build
+
+_lib = None
+_lib_err = None
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    try:
+        path = _build.build()
+        lib = ctypes.CDLL(path)
+    except Exception as e:  # pragma: no cover - toolchain-less environments
+        _lib_err = e
+        return None
+
+    lib.rio_writer_open.restype = ctypes.c_void_p
+    lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_uint64]
+    lib.rio_writer_write.restype = ctypes.c_int
+    lib.rio_writer_write.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint8),
+                                     ctypes.c_uint64]
+    lib.rio_writer_close.restype = ctypes.c_int
+    lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+
+    lib.rio_reader_open.restype = ctypes.c_void_p
+    lib.rio_reader_open.argtypes = [ctypes.c_char_p]
+    lib.rio_reader_open_at.restype = ctypes.c_void_p
+    lib.rio_reader_open_at.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.rio_reader_read.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.rio_reader_read.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.rio_reader_error.restype = ctypes.c_char_p
+    lib.rio_reader_error.argtypes = [ctypes.c_void_p]
+    lib.rio_reader_close.argtypes = [ctypes.c_void_p]
+    lib.rio_reader_chunk_drained.restype = ctypes.c_int
+    lib.rio_reader_chunk_drained.argtypes = [ctypes.c_void_p]
+    lib.rio_index.restype = ctypes.c_int64
+    lib.rio_index.argtypes = [ctypes.c_char_p,
+                              ctypes.POINTER(ctypes.c_uint64),
+                              ctypes.POINTER(ctypes.c_uint32),
+                              ctypes.c_int64]
+
+    lib.loader_create.restype = ctypes.c_void_p
+    lib.loader_create.argtypes = [ctypes.POINTER(ctypes.c_char_p),
+                                  ctypes.c_int64, ctypes.c_int,
+                                  ctypes.c_uint64, ctypes.c_int64]
+    lib.loader_next.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.loader_next.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_uint64)]
+    lib.loader_error.restype = ctypes.c_char_p
+    lib.loader_error.argtypes = [ctypes.c_void_p]
+    lib.loader_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.buddy_create.restype = ctypes.c_void_p
+    lib.buddy_create.argtypes = [ctypes.c_uint64]
+    lib.buddy_alloc.restype = ctypes.c_void_p
+    lib.buddy_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.buddy_free.restype = ctypes.c_int
+    lib.buddy_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.buddy_used.restype = ctypes.c_uint64
+    lib.buddy_used.argtypes = [ctypes.c_void_p]
+    lib.buddy_capacity.restype = ctypes.c_uint64
+    lib.buddy_capacity.argtypes = [ctypes.c_void_p]
+    lib.buddy_destroy.argtypes = [ctypes.c_void_p]
+
+    _lib = lib
+    return _lib
+
+
+def available():
+    return _load() is not None
+
+
+def lib():
+    l = _load()
+    if l is None:
+        raise RuntimeError(f"native library unavailable: {_lib_err}")
+    return l
+
+
+# ---------------------------------------------------------------- loader
+class Loader:
+    """Multithreaded prefetching reader over recordio files.
+
+    Iterates raw record bytes; deterministic chunk-order shuffle when
+    ``shuffle_seed >= 0``."""
+
+    def __init__(self, paths, num_threads=4, queue_cap=4096,
+                 shuffle_seed=-1):
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        self._lib = lib()
+        arr = (ctypes.c_char_p * len(paths))(
+            *[os.fspath(p).encode() for p in paths]
+        )
+        self._h = self._lib.loader_create(
+            arr, len(paths), num_threads, queue_cap, shuffle_seed
+        )
+        if not self._h:
+            raise IOError(f"loader_create failed for {paths}")
+
+    def __iter__(self):
+        n = ctypes.c_uint64()
+        while True:
+            p = self._lib.loader_next(self._h, ctypes.byref(n))
+            if not p:
+                err = self._lib.loader_error(self._h)
+                if err:
+                    raise IOError(f"loader: {err.decode()}")
+                return
+            yield ctypes.string_at(p, n.value)
+
+    def close(self):
+        if self._h:
+            self._lib.loader_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------- buddy allocator
+class BuddyAllocator:
+    """Power-of-two buddy arena over mmap'd host memory."""
+
+    def __init__(self, arena_bytes=64 << 20):
+        self._lib = lib()
+        self._h = self._lib.buddy_create(arena_bytes)
+        if not self._h:
+            raise MemoryError("buddy_create failed")
+
+    def alloc(self, n):
+        p = self._lib.buddy_alloc(self._h, n)
+        if not p:
+            raise MemoryError(f"buddy arena exhausted allocating {n} bytes")
+        return p
+
+    def free(self, p):
+        if self._lib.buddy_free(self._h, p) != 0:
+            raise ValueError("bad pointer passed to buddy_free")
+
+    @property
+    def used(self):
+        return self._lib.buddy_used(self._h)
+
+    @property
+    def capacity(self):
+        return self._lib.buddy_capacity(self._h)
+
+    def buffer(self, n):
+        """A Python memoryview over a fresh allocation (for staging)."""
+        p = self.alloc(n)
+        return p, (ctypes.c_uint8 * n).from_address(p)
+
+    def destroy(self):
+        if self._h:
+            self._lib.buddy_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

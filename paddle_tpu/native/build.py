@@ -1,0 +1,67 @@
+"""Build driver for the native C++ runtime library.
+
+Compiles ``src/*.cc`` into one shared object with g++ (no pybind11 in the
+image — the ABI is flat C consumed via ctypes).  Rebuilds only when source
+hashes change; the result is cached under ``_build/``.
+"""
+
+import hashlib
+import os
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC_DIR = os.path.join(_HERE, "src")
+BUILD_DIR = os.path.join(_HERE, "_build")
+LIB_BASENAME = "libpaddle_tpu_native.so"
+
+CXXFLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+LDLIBS = ["-lz"]
+
+
+def _sources():
+    return sorted(
+        os.path.join(SRC_DIR, f)
+        for f in os.listdir(SRC_DIR)
+        if f.endswith(".cc")
+    )
+
+
+def _digest(sources):
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(CXXFLAGS + LDLIBS).encode())
+    return h.hexdigest()[:16]
+
+
+def build(force=False):
+    """Compile (if stale) and return the path to the shared library, or
+    None when no C++ toolchain is available (pure-Python fallbacks take
+    over)."""
+    sources = _sources()
+    if not sources:
+        return None
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    stamp = os.path.join(BUILD_DIR, "stamp")
+    lib = os.path.join(BUILD_DIR, LIB_BASENAME)
+    digest = _digest(sources)
+    if not force and os.path.exists(lib) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == digest:
+                return lib
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx] + CXXFLAGS + sources + ["-o", lib] + LDLIBS
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        err = getattr(e, "stderr", str(e))
+        raise RuntimeError(f"native build failed:\n{err}") from e
+    with open(stamp, "w") as f:
+        f.write(digest)
+    return lib
+
+
+if __name__ == "__main__":
+    print(build(force=True))

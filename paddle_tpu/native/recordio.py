@@ -1,0 +1,208 @@
+"""recordio — Python API over the native chunked record format.
+
+Reference capability: RecordIO files are the cluster dataset interchange
+format — ``python/paddle/v2/reader/creator.py:60 recordio`` reads them and
+``go/master/service.go:106 partition`` splits them into chunk tasks.  The
+native implementation lives in ``src/recordio.cc``; a pure-Python
+implementation of the same on-disk format is provided as fallback and as a
+cross-check in tests.
+"""
+
+import ctypes
+import os
+import struct
+import zlib
+
+from . import available, lib
+
+MAGIC = 0x50545243
+_HEADER = struct.Struct("<IIIIQQ")  # magic, compressor, crc, nrec, raw, stored
+
+
+class Writer:
+    """Append records to a recordio file.
+
+    compressor: 0 = none, 1 = zlib."""
+
+    def __init__(self, path, compressor=0, max_chunk_bytes=1 << 20,
+                 use_native=None):
+        self.path = os.fspath(path)
+        use_native = available() if use_native is None else use_native
+        self._native = None
+        if use_native:
+            self._lib = lib()
+            self._native = self._lib.rio_writer_open(
+                self.path.encode(), compressor, max_chunk_bytes
+            )
+            if not self._native:
+                raise IOError(f"cannot open {path} for writing")
+        else:
+            self._f = open(self.path, "wb")
+            self._compressor = compressor
+            self._max = max_chunk_bytes
+            self._buf = bytearray()
+            self._nrec = 0
+
+    def write(self, record: bytes):
+        if self._native:
+            buf = (ctypes.c_uint8 * len(record)).from_buffer_copy(record)
+            if self._lib.rio_writer_write(self._native, buf, len(record)) != 0:
+                raise IOError("recordio write failed")
+            return
+        self._buf += struct.pack("<I", len(record)) + record
+        self._nrec += 1
+        if len(self._buf) >= self._max:
+            self._flush()
+
+    def _flush(self):
+        if self._nrec == 0:
+            return
+        raw = bytes(self._buf)
+        stored = zlib.compress(raw) if self._compressor == 1 else raw
+        crc = zlib.crc32(stored) & 0xFFFFFFFF
+        self._f.write(_HEADER.pack(MAGIC, self._compressor, crc, self._nrec,
+                                   len(raw), len(stored)))
+        self._f.write(stored)
+        self._buf = bytearray()
+        self._nrec = 0
+
+    def close(self):
+        if self._native:
+            rc = self._lib.rio_writer_close(self._native)
+            self._native = None
+            if rc != 0:
+                raise IOError("recordio close failed")
+        elif getattr(self, "_f", None):
+            self._flush()
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _py_read_chunks(f):
+    while True:
+        hdr = f.read(_HEADER.size)
+        if not hdr:
+            return
+        if len(hdr) < _HEADER.size:
+            raise IOError("truncated recordio chunk header")
+        magic, comp, crc, nrec, raw_len, stored_len = _HEADER.unpack(hdr)
+        if magic != MAGIC:
+            raise IOError("corrupt recordio chunk header")
+        stored = f.read(stored_len)
+        if len(stored) != stored_len:
+            raise IOError("truncated recordio chunk")
+        if zlib.crc32(stored) & 0xFFFFFFFF != crc:
+            raise IOError("recordio crc mismatch")
+        payload = zlib.decompress(stored) if comp == 1 else stored
+        pos = 0
+        for _ in range(nrec):
+            (ln,) = struct.unpack_from("<I", payload, pos)
+            pos += 4
+            yield payload[pos:pos + ln]
+            pos += ln
+
+
+def reader(path, use_native=None):
+    """Generator over records of a recordio file."""
+    use_native = available() if use_native is None else use_native
+    path = os.fspath(path)
+    if not use_native:
+        with open(path, "rb") as f:
+            yield from _py_read_chunks(f)
+        return
+    l = lib()
+    h = l.rio_reader_open(path.encode())
+    if not h:
+        raise IOError(f"cannot open {path}")
+    try:
+        n = ctypes.c_uint64()
+        while True:
+            p = l.rio_reader_read(h, ctypes.byref(n))
+            if not p:
+                err = l.rio_reader_error(h)
+                if err:
+                    raise IOError(f"recordio: {err.decode()}")
+                return
+            yield ctypes.string_at(p, n.value)
+    finally:
+        l.rio_reader_close(h)
+
+
+def index(path):
+    """[(chunk_offset, num_records)] — the master's partition unit
+    (go/master/service.go:106)."""
+    path = os.fspath(path)
+    if not available():
+        out = []
+        with open(path, "rb") as f:
+            while True:
+                off = f.tell()
+                hdr = f.read(_HEADER.size)
+                if not hdr:
+                    return out
+                if len(hdr) < _HEADER.size:
+                    raise IOError("truncated recordio chunk header")
+                magic, _, _, nrec, _, stored_len = _HEADER.unpack(hdr)
+                if magic != MAGIC:
+                    raise IOError("corrupt recordio chunk header")
+                out.append((off, nrec))
+                f.seek(stored_len, os.SEEK_CUR)
+        return out
+    l = lib()
+    cnt = l.rio_index(path.encode(), None, None, 0)
+    if cnt < 0:
+        raise IOError(f"cannot index {path}")
+    offs = (ctypes.c_uint64 * cnt)()
+    counts = (ctypes.c_uint32 * cnt)()
+    l.rio_index(path.encode(), offs, counts, cnt)
+    return list(zip(offs, counts))
+
+
+def read_chunk(path, offset):
+    """Records of the single chunk at ``offset`` (task execution)."""
+    if not available():
+        with open(path, "rb") as f:
+            f.seek(offset)
+            hdr = f.read(_HEADER.size)
+            if len(hdr) < _HEADER.size:
+                raise IOError("truncated recordio chunk header")
+            magic, comp, crc, nrec, raw_len, stored_len = _HEADER.unpack(hdr)
+            if magic != MAGIC:
+                raise IOError("corrupt recordio chunk header")
+            stored = f.read(stored_len)
+            if len(stored) != stored_len:
+                raise IOError("truncated recordio chunk")
+            if zlib.crc32(stored) & 0xFFFFFFFF != crc:
+                raise IOError("recordio crc mismatch")
+            payload = zlib.decompress(stored) if comp == 1 else stored
+            pos = 0
+            for _ in range(nrec):
+                (ln,) = struct.unpack_from("<I", payload, pos)
+                pos += 4
+                yield payload[pos:pos + ln]
+                pos += ln
+        return
+    l = lib()
+    h = l.rio_reader_open_at(os.fspath(path).encode(), offset)
+    if not h:
+        raise IOError(f"cannot open {path}@{offset}")
+    try:
+        n = ctypes.c_uint64()
+        while True:
+            p = l.rio_reader_read(h, ctypes.byref(n))
+            if not p:
+                err = l.rio_reader_error(h)
+                if err:
+                    raise IOError(f"recordio: {err.decode()}")
+                return
+            yield ctypes.string_at(p, n.value)
+            if l.rio_reader_chunk_drained(h):
+                return
+    finally:
+        l.rio_reader_close(h)

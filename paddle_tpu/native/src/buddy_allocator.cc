@@ -1,0 +1,144 @@
+// buddy_allocator.cc — power-of-two buddy allocator over one mmap'd arena.
+//
+// Native memory-management layer mirroring the capability of the reference's
+// fluid allocator (paddle/memory/detail/buddy_allocator.{h,cc} over system
+// allocators, exposed as memory::Alloc/Free/Used — paddle/memory/memory.h:36).
+// On TPU the device heap belongs to XLA/PJRT, so this arena serves the
+// *host* side: staging buffers for the data loader and feed pipeline, where
+// steady-state training must not churn malloc.
+//
+// Flat C ABI for ctypes.
+
+#include <sys/mman.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+constexpr int kMinOrder = 6;  // 64-byte blocks (cacheline)
+
+struct Buddy {
+  uint8_t* base = nullptr;
+  size_t arena_size = 0;
+  int max_order = 0;
+  // free_lists[o] holds offsets of free blocks of size 1<<o
+  std::vector<std::vector<size_t>> free_lists;
+  // order of the block allocated at offset (or -1)
+  std::vector<int8_t> alloc_order;  // indexed by offset >> kMinOrder
+  size_t used = 0;
+  std::mutex mu;
+
+  explicit Buddy(size_t size) {
+    // round up to power of two
+    int order = kMinOrder;
+    while ((size_t(1) << order) < size) order++;
+    arena_size = size_t(1) << order;
+    max_order = order;
+    base = static_cast<uint8_t*>(mmap(nullptr, arena_size,
+                                      PROT_READ | PROT_WRITE,
+                                      MAP_PRIVATE | MAP_ANONYMOUS, -1, 0));
+    if (base == MAP_FAILED) {
+      base = nullptr;
+      return;
+    }
+    free_lists.resize(max_order + 1);
+    free_lists[max_order].push_back(0);
+    alloc_order.assign(arena_size >> kMinOrder, -1);
+  }
+
+  ~Buddy() {
+    if (base) munmap(base, arena_size);
+  }
+
+  static int order_for(size_t n) {
+    int o = kMinOrder;
+    while ((size_t(1) << o) < n) o++;
+    return o;
+  }
+
+  void* alloc(size_t n) {
+    if (n == 0 || !base) return nullptr;
+    int want = order_for(n);
+    if (want > max_order) return nullptr;
+    std::lock_guard<std::mutex> lock(mu);
+    int o = want;
+    while (o <= max_order && free_lists[o].empty()) o++;
+    if (o > max_order) return nullptr;  // out of arena
+    size_t off = free_lists[o].back();
+    free_lists[o].pop_back();
+    // split down to the wanted order, pushing buddies back
+    while (o > want) {
+      o--;
+      free_lists[o].push_back(off + (size_t(1) << o));
+    }
+    alloc_order[off >> kMinOrder] = static_cast<int8_t>(want);
+    used += size_t(1) << want;
+    return base + off;
+  }
+
+  bool free(void* p) {
+    if (!p) return true;
+    size_t off = static_cast<uint8_t*>(p) - base;
+    if (off >= arena_size) return false;
+    std::lock_guard<std::mutex> lock(mu);
+    int o = alloc_order[off >> kMinOrder];
+    if (o < 0) return false;  // double free / bad pointer
+    alloc_order[off >> kMinOrder] = -1;
+    used -= size_t(1) << o;
+    // coalesce with buddy while possible
+    while (o < max_order) {
+      size_t buddy = off ^ (size_t(1) << o);
+      auto& fl = free_lists[o];
+      bool merged = false;
+      for (size_t i = 0; i < fl.size(); i++) {
+        if (fl[i] == buddy) {
+          fl[i] = fl.back();
+          fl.pop_back();
+          off = off < buddy ? off : buddy;
+          o++;
+          merged = true;
+          break;
+        }
+      }
+      if (!merged) break;
+    }
+    free_lists[o].push_back(off);
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* buddy_create(uint64_t arena_bytes) {
+  auto* b = new Buddy(arena_bytes);
+  if (!b->base) {
+    delete b;
+    return nullptr;
+  }
+  return b;
+}
+
+void* buddy_alloc(void* handle, uint64_t n) {
+  return static_cast<Buddy*>(handle)->alloc(n);
+}
+
+int buddy_free(void* handle, void* p) {
+  return static_cast<Buddy*>(handle)->free(p) ? 0 : -1;
+}
+
+uint64_t buddy_used(void* handle) {
+  return static_cast<Buddy*>(handle)->used;
+}
+
+uint64_t buddy_capacity(void* handle) {
+  return static_cast<Buddy*>(handle)->arena_size;
+}
+
+void buddy_destroy(void* handle) { delete static_cast<Buddy*>(handle); }
+
+}  // extern "C"

@@ -68,10 +68,21 @@ def glu(input, dim=-1):
 
 
 def scaled_dot_product_attention(queries, keys, values, num_heads=1,
-                                 dropout_rate=0.0):
+                                 dropout_rate=0.0, use_fused=True):
     """Multi-head scaled dot-product attention over dense [b, t, d] tensors
-    (fluid nets.py scaled_dot_product_attention)."""
+    (fluid nets.py scaled_dot_product_attention).  Without dropout the fused
+    Pallas flash-attention kernel is used; with dropout (or
+    ``use_fused=False``) it falls back to the composed softmax(QK^T)V."""
     d = queries.shape[-1]
+    if use_fused and not dropout_rate and d % num_heads == 0:
+        b, tq = queries.shape[0], queries.shape[1]
+        tk = keys.shape[1]
+        hd = d // num_heads
+        q4 = layers.reshape(queries, [0, tq, num_heads, hd])
+        k4 = layers.reshape(keys, [0, tk, num_heads, hd])
+        v4 = layers.reshape(values, [0, tk, num_heads, hd])
+        out = layers.flash_attention(q4, k4, v4)
+        return layers.reshape(out, [0, tq, d])
     scaled_q = layers.scale(queries, scale=float(d) ** -0.5)
     product = layers.matmul(scaled_q, keys, transpose_y=True)
     weights = layers.softmax(product)

@@ -25,3 +25,4 @@ from . import detection_ops
 from . import ctc_ops
 from . import crf_ops
 from . import io_ops
+from . import pallas_attention

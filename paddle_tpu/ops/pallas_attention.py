@@ -1,0 +1,220 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference has no fused attention (attention is composed from fc +
+softmax in ``trainer_config_helpers/networks.py simple_attention``); on TPU
+the fused blockwise kernel is the difference between O(t^2) HBM traffic and
+O(t) — this is the hot-op Pallas path of the framework (pallas_guide.md
+patterns: grid over (batch*heads, q-blocks), online softmax in VMEM,
+custom VJP with recompute backward).
+
+Layout: q [b, t_q, h, d], k/v [b, t_k, h, d] (same as parallel.ring_attention,
+whose per-device inner block this kernel accelerates).
+
+Forward: Pallas kernel, one grid cell per (batch*head, q-block); inner
+fori_loop streams K/V blocks through VMEM with online softmax.
+Backward: custom_vjp — blockwise recompute in plain JAX (XLA fuses the
+einsums onto the MXU; memory stays O(t * block)).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _pick_block(t, cap):
+    """Largest divisor of t that is <= cap (TPU-friendly when t is a
+    multiple of 128; always exact so no masking is needed)."""
+    b = min(t, cap)
+    while t % b:
+        b -= 1
+    return b
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
+                      causal, block_q, block_k, t_k):
+    import jax.experimental.pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    bq, d = q.shape
+    j = pl.program_id(1)
+    q_pos = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    nk = t_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [bq, bk]
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m2 = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m2)
+        p = jnp.exp(s - m2[:, None])
+        l2 = l * alpha + jnp.sum(p, axis=-1)
+        acc2 = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m2, l2, acc2
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = _pick_block(t_q, block_q)
+    block_k = _pick_block(t_k, block_k)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, t_k=t_k,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, t_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t_k, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_k):
+    """Blockwise backward from saved lse (plain JAX; scan over K/V blocks
+    keeps memory O(t*block) while XLA runs the einsums on the MXU)."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_k = _pick_block(t_k, block_k)
+    nk = t_k // block_k
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [bh, tq]
+    q_pos = jnp.arange(t_q)[:, None]
+
+    kb = jnp.swapaxes(k.reshape(bh, nk, block_k, d), 0, 1)
+    vb = jnp.swapaxes(v.reshape(bh, nk, block_k, d), 0, 1)
+
+    def body(dq_acc, blk):
+        kk, vv, idx = blk
+        kkf = kk.astype(jnp.float32)
+        vvf = vv.astype(jnp.float32)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kkf,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            k_pos = idx * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, :, None])  # [bh, tq, bk]
+        dv = jnp.einsum("bqk,bqd->bkd", p, dof,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vvf,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, :, None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kkf,
+                                     preferred_element_type=jnp.float32)
+        dk = jnp.einsum("bqk,bqd->bkd", ds, qf,
+                        preferred_element_type=jnp.float32)
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((bh, t_q, d), jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, (kb, vb, jnp.arange(nk)))
+    dk = jnp.swapaxes(dks, 0, 1).reshape(bh, t_k, d)
+    dv = jnp.swapaxes(dvs, 0, 1).reshape(bh, t_k, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_k)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=256,
+                    block_k=256, interpret=None):
+    """Fused attention.  q [b, t_q, h, d], k/v [b, t_k, h, d] ->
+    [b, t_q, h, d].  Differentiable (custom VJP).  ``interpret=None``
+    auto-selects Pallas interpreter mode off-TPU so the same code path runs
+    in CPU tests."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+
+    def pack(x, t):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, t, x.shape[-1])
+
+    o = _flash_core(
+        pack(q, t_q), pack(k, t_k), pack(v, t_k),
+        float(sm_scale), bool(causal), int(block_q), int(block_k),
+        bool(interpret),
+    )
+    return jnp.swapaxes(o.reshape(b, h, t_q, d), 1, 2)
+
+
+def attention_reference(q, k, v, causal=False, sm_scale=None):
+    """Dense reference implementation (for tests and tiny shapes)."""
+    d = q.shape[-1]
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        t_q, t_k = logits.shape[-2:]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+# -- op registration ---------------------------------------------------------
+from ..core.registry import register_op
+
+
+@register_op("flash_attention")
+def flash_attention_op(Q, K, V, causal=False, sm_scale=0.0, **_):
+    scale = None if not sm_scale else float(sm_scale)
+    return {"Out": flash_attention(Q, K, V, causal=causal, sm_scale=scale)}

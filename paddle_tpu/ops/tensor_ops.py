@@ -47,7 +47,9 @@ def shape_op(Input, **_):
 
 @register_op("reshape")
 def reshape(X, shape=(), **_):
-    shape = [int(s) for s in shape]
+    # Paddle convention: 0 means "copy this dim from the input".
+    shape = [int(X.shape[i]) if int(s) == 0 else int(s)
+             for i, s in enumerate(shape)]
     return {"Out": X.reshape(tuple(shape))}
 
 

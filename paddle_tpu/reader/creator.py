@@ -23,18 +23,32 @@ def text_file(path):
     return reader
 
 
-def recordio(paths, buf_size=100):
-    """Read record files written by paddle_tpu.dataset.common.convert (a
-    simple length-prefixed record format standing in for RecordIO)."""
-    from ..dataset.common import read_records
+def recordio(paths, buf_size=100, num_threads=0, shuffle_seed=-1):
+    """Read recordio files written by paddle_tpu.dataset.common.convert
+    (reference creator.py:60).  With ``num_threads > 0`` the native
+    multithreaded prefetching Loader decodes chunks off the main thread
+    (PyDataProvider2's background-feed pattern, now in C++)."""
     import pickle
+
+    from .. import native
+    from ..native import recordio as rio
 
     if isinstance(paths, str):
         paths = paths.split(",")
 
+    if num_threads > 0 and native.available():
+        def reader():
+            with native.Loader(paths, num_threads=num_threads,
+                               queue_cap=max(buf_size, 16),
+                               shuffle_seed=shuffle_seed) as loader:
+                for rec in loader:
+                    yield pickle.loads(rec)
+
+        return reader
+
     def reader():
         for p in paths:
-            for rec in read_records(p):
+            for rec in rio.reader(p):
                 yield pickle.loads(rec)
 
     return reader
