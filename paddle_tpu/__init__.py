@@ -62,5 +62,13 @@ from . import trainer
 from . import models
 from . import inference
 from . import distributed
+from . import flags
+from .flags import FLAGS
+from . import memory_optimization_transpiler
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from . import net_drawer
+from . import parameters
+from . import plot
+from . import native
 
 __version__ = "0.1.0"

@@ -180,6 +180,25 @@ class Executor:
             self._cache[key] = step
 
         new_state, fetches = step(state, *feed_vals)
+        from ..flags import FLAGS
+
+        if FLAGS.check_nan_inf:
+            # FLAGS_check_nan_inf analog (reference executor.cc:131): scan
+            # everything the step produced.  Host-side sync — debug only.
+            for name, arr in list(new_state.items()) + list(
+                zip(fetch_names, fetches)
+            ):
+                a = np.asarray(arr)
+                if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+                    raise FloatingPointError(
+                        f"NaN/Inf detected in {name!r} after step"
+                    )
+        if FLAGS.do_memory_benchmark:
+            total = sum(
+                np.asarray(v).nbytes for v in new_state.values()
+            )
+            print(f"[memory] live state: {total / 1e6:.2f} MB "
+                  f"({len(new_state)} vars)")
         scope.update(new_state)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
@@ -224,12 +243,44 @@ class Executor:
                     n for n in info["params"] if n in env
                 ]
 
+                segments = getattr(program, "_remat_segments", None)
+
                 def fwd(tparams, env0):
                     e = dict(env0)
                     e.update(tparams)
-                    run_block_ops(
-                        ctx, block, block.ops[:bw], e, inside_grad_prefix=True
-                    )
+                    if not segments:
+                        run_block_ops(
+                            ctx, block, block.ops[:bw], e,
+                            inside_grad_prefix=True,
+                        )
+                    else:
+                        # memory_optimize marked remat boundaries: run each
+                        # forward segment under jax.checkpoint so backward
+                        # recomputes activations instead of storing them.
+                        for s, t in segments:
+                            seg_ops = block.ops[s:t]
+                            written = {
+                                n for op in seg_ops for n in op.output_names()
+                            }
+                            out_names = tuple(sorted(written))
+
+                            # checkpoint may trace seg_fn more than once;
+                            # pin the random-op key counter to the segment
+                            # start so fwd and remat derive identical keys
+                            c0 = ctx._op_counter
+
+                            def seg_fn(env_in, _ops=seg_ops, _out=out_names,
+                                       _c0=c0):
+                                ctx._op_counter = _c0
+                                e2 = dict(env_in)
+                                run_block_ops(
+                                    ctx, block, _ops, e2,
+                                    inside_grad_prefix=True,
+                                )
+                                return {n: e2[n] for n in _out if n in e2}
+
+                            outs = jax.checkpoint(seg_fn)(e)
+                            e.update(outs)
                     loss = e[info["loss"]]
                     return jnp.sum(loss), e
 

@@ -14,12 +14,17 @@ from . import mnist
 from . import cifar
 from . import uci_housing
 from . import imdb
+from . import imikolov
+from . import sentiment
 from . import movielens
 from . import wmt14
 from . import wmt16
 from . import conll05
+from . import flowers
+from . import voc2012
 
 __all__ = [
-    "common", "mnist", "cifar", "uci_housing", "imdb", "movielens",
-    "wmt14", "wmt16", "conll05",
+    "common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
+    "sentiment", "movielens", "wmt14", "wmt16", "conll05", "flowers",
+    "voc2012",
 ]

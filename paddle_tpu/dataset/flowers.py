@@ -1,0 +1,56 @@
+"""Oxford 102 Flowers (reference: v2/dataset/flowers.py — 102-class image
+classification with jpeg decode + augmentation).  Schema: (3x224x224
+float32 image scaled to [0,1], int64 label 0-101).  Real data if the
+extracted image .npy cache exists; else class-conditional synthetic."""
+
+import os
+
+import numpy as np
+
+from . import common
+
+CLASS_NUM = 102
+_SHAPE = (3, 224, 224)
+
+
+def _real_reader(images_npy, labels_npy):
+    def reader():
+        images = np.load(images_npy, mmap_mode="r")
+        labels = np.load(labels_npy)
+        for i in range(len(labels)):
+            yield np.asarray(images[i], np.float32), int(labels[i])
+
+    return reader
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        protos = rng.rand(CLASS_NUM, 3, 8, 8).astype(np.float32)
+        for _ in range(n):
+            label = int(rng.randint(0, CLASS_NUM))
+            base = np.kron(protos[label], np.ones((28, 28), np.float32))
+            img = np.clip(base + 0.1 * rng.randn(*_SHAPE), 0, 1)
+            yield img.astype(np.float32), label
+
+    return reader
+
+
+def _reader(split, n_syn, seed):
+    img = common.data_path("flowers", f"{split}_images.npy")
+    lbl = common.data_path("flowers", f"{split}_labels.npy")
+    if os.path.exists(img) and os.path.exists(lbl):
+        return _real_reader(img, lbl)
+    return _synthetic(n_syn, seed)
+
+
+def train():
+    return _reader("train", 1024, seed=81)
+
+
+def test():
+    return _reader("test", 256, seed=82)
+
+
+def valid():
+    return _reader("valid", 256, seed=83)

@@ -1,0 +1,72 @@
+"""Movie-review sentiment dataset (reference: v2/dataset/sentiment.py —
+NLTK movie_reviews corpus, binary labels).  Schema: (list of word ids,
+int64 label in {0, 1})."""
+
+import os
+
+import numpy as np
+
+from . import common
+
+_SYN_VOCAB = 5000
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+
+def get_word_dict():
+    path = common.data_path("sentiment", "vocab.txt")
+    if os.path.exists(path):
+        with open(path) as f:
+            return {w.strip(): i for i, w in enumerate(f)}
+    return {f"w{i}": i for i in range(_SYN_VOCAB)}
+
+
+def _file_reader(path, word_dict):
+    def reader():
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                words, label = parts
+                ids = [word_dict[w] for w in words.split() if w in word_dict]
+                if ids:
+                    yield ids, int(label)
+
+    return reader
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        # two sentiment "lexicons": label determined by which dominates
+        pos = rng.randint(0, _SYN_VOCAB // 2, size=_SYN_VOCAB // 10)
+        neg = rng.randint(_SYN_VOCAB // 2, _SYN_VOCAB, size=_SYN_VOCAB // 10)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            lexicon = pos if label else neg
+            length = int(rng.randint(8, 60))
+            ids = [
+                int(lexicon[rng.randint(0, len(lexicon))])
+                if rng.rand() < 0.7 else int(rng.randint(0, _SYN_VOCAB))
+                for _ in range(length)
+            ]
+            yield ids, label
+
+    return reader
+
+
+def _reader(split, n_syn, seed):
+    path = common.data_path("sentiment", f"{split}.tsv")
+    if os.path.exists(path):
+        return _file_reader(path, get_word_dict())
+    return _synthetic(n_syn, seed)
+
+
+def train():
+    return _reader("train", NUM_TRAINING_INSTANCES, seed=71)
+
+
+def test():
+    return _reader("test", NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES,
+                   seed=72)

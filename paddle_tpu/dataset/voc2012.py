@@ -1,0 +1,58 @@
+"""PASCAL VOC2012 segmentation dataset (reference: v2/dataset/voc2012.py —
+(image, segmentation-label) pairs).  Schema: (3xHxW float32 image in [0,1],
+HxW int64 label map with classes 0-20; 255 = ignore)."""
+
+import os
+
+import numpy as np
+
+from . import common
+
+CLASS_NUM = 21
+IGNORE_LABEL = 255
+_H = _W = 96  # synthetic surrogate resolution
+
+
+def _real_reader(images_npy, labels_npy):
+    def reader():
+        images = np.load(images_npy, mmap_mode="r")
+        labels = np.load(labels_npy, mmap_mode="r")
+        for i in range(len(images)):
+            yield (np.asarray(images[i], np.float32),
+                   np.asarray(labels[i], np.int64))
+
+    return reader
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            img = rng.rand(3, _H, _W).astype(np.float32)
+            label = np.zeros((_H, _W), np.int64)
+            # a few random class rectangles, correlated with a color bump
+            for _ in range(int(rng.randint(1, 4))):
+                c = int(rng.randint(1, CLASS_NUM))
+                y0, x0 = rng.randint(0, _H - 16), rng.randint(0, _W - 16)
+                h, w = rng.randint(8, 16), rng.randint(8, 16)
+                label[y0:y0 + h, x0:x0 + w] = c
+                img[:, y0:y0 + h, x0:x0 + w] += c / CLASS_NUM
+            yield np.clip(img, 0, 1), label
+
+    return reader
+
+
+def _reader(split, n_syn, seed):
+    img = common.data_path("voc2012", f"{split}_images.npy")
+    lbl = common.data_path("voc2012", f"{split}_labels.npy")
+    if os.path.exists(img) and os.path.exists(lbl):
+        return _real_reader(img, lbl)
+    return _synthetic(n_syn, seed)
+
+
+def train():
+    return _reader("train", 512, seed=91)
+
+
+def test():
+    return _reader("val", 128, seed=92)

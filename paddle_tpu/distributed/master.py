@@ -94,9 +94,11 @@ class MasterService:
                 try:
                     for off, _cnt in recordio.index(p):
                         chunks.append([p, int(off)])
-                except IOError:
-                    # not a recordio file: whole file = one chunk
-                    chunks.append([p, -1])
+                except IOError as e:
+                    # fail fast at registration: a bad file would otherwise
+                    # become a poison task crashing every trainer that
+                    # leases it
+                    raise IOError(f"set_dataset: cannot index {p}: {e}")
             for i in range(0, len(chunks), self.chunks_per_task):
                 self.todo.append(
                     Task(str(uuid.uuid4()), chunks[i : i + self.chunks_per_task])

@@ -19,11 +19,14 @@ CXXFLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
 LDLIBS = ["-lz"]
 
 
+CAPI_SOURCES = {"capi.cc"}  # built separately (needs Python headers)
+
+
 def _sources():
     return sorted(
         os.path.join(SRC_DIR, f)
         for f in os.listdir(SRC_DIR)
-        if f.endswith(".cc")
+        if f.endswith(".cc") and f not in CAPI_SOURCES
     )
 
 
@@ -63,5 +66,38 @@ def build(force=False):
     return lib
 
 
+def build_capi(force=False):
+    """Compile the C inference API (embeds CPython) into
+    libpaddle_tpu_capi.so; returns its path."""
+    src = os.path.join(SRC_DIR, "capi.cc")
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    lib = os.path.join(BUILD_DIR, "libpaddle_tpu_capi.so")
+    stamp = os.path.join(BUILD_DIR, "capi.stamp")
+    h = hashlib.sha256()
+    with open(src, "rb") as f:
+        h.update(f.read())
+    digest = h.hexdigest()[:16]
+    if not force and os.path.exists(lib) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == digest:
+                return lib
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx] + CXXFLAGS + [f"-I{inc}", src, "-o", lib,
+                              f"-L{libdir}", f"-lpython{pyver}"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        err = getattr(e, "stderr", str(e))
+        raise RuntimeError(f"capi build failed:\n{err}") from e
+    with open(stamp, "w") as f:
+        f.write(digest)
+    return lib
+
+
 if __name__ == "__main__":
     print(build(force=True))
+    print(build_capi(force=True))

@@ -1,0 +1,59 @@
+/* Example C consumer of the paddle_tpu C inference API (reference:
+ * paddle/capi/examples/model_inference).  Loads an exported model dir and
+ * runs one batch of float32 inputs read as argv:
+ *
+ *   ./infer <pythonpath> <model_dir> <feed_name> <d0> <d1> v0 v1 ...
+ *
+ * Prints the flat output values, one per line.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 6) {
+    fprintf(stderr, "usage: %s pythonpath model_dir feed d0 d1 v...\n",
+            argv[0]);
+    return 2;
+  }
+  if (pt_init(argv[1]) != 0) {
+    fprintf(stderr, "init failed: %s\n", pt_last_error());
+    return 1;
+  }
+  void* h = pt_engine_create(argv[2]);
+  if (!h) {
+    fprintf(stderr, "load failed: %s\n", pt_last_error());
+    return 1;
+  }
+  int64_t shape[2] = {atoll(argv[4]), atoll(argv[5])};
+  int64_t numel = shape[0] * shape[1];
+  if (argc - 6 != numel) {
+    fprintf(stderr, "expected %lld values\n", (long long)numel);
+    return 2;
+  }
+  float* data = (float*)malloc(sizeof(float) * numel);
+  for (int64_t i = 0; i < numel; i++) data[i] = (float)atof(argv[6 + i]);
+
+  const char* names[1] = {argv[3]};
+  const float* datas[1] = {data};
+  const int64_t* shapes[1] = {shape};
+  int32_t ranks[1] = {2};
+
+  const float* out;
+  const int64_t* out_shape;
+  int32_t out_rank;
+  if (pt_engine_run(h, names, datas, shapes, ranks, 1, 0, &out, &out_shape,
+                    &out_rank) != 0) {
+    fprintf(stderr, "run failed: %s\n", pt_last_error());
+    return 1;
+  }
+  int64_t n = 1;
+  for (int32_t d = 0; d < out_rank; d++) n *= out_shape[d];
+  for (int64_t i = 0; i < n; i++) printf("%f\n", out[i]);
+
+  pt_engine_destroy(h);
+  pt_shutdown();
+  free(data);
+  return 0;
+}

@@ -1,0 +1,58 @@
+/* C inference API (reference: paddle/capi — gradient_machine.h, matrix.h).
+ *
+ * Load a model exported by paddle_tpu.io.save_inference_model and run
+ * forward passes from C/C++.  Link against libpaddle_tpu_capi.so (which
+ * embeds a Python interpreter driving the XLA-compiled engine).
+ *
+ * Minimal usage:
+ *   pt_init("/path/containing/paddle_tpu");
+ *   void* h = pt_engine_create("/path/to/exported_model");
+ *   const float* out; const int64_t* shape; int32_t rank;
+ *   pt_engine_run(h, names, datas, shapes, ranks, n_inputs, 0,
+ *                 &out, &shape, &rank);
+ *   pt_engine_destroy(h);
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Initialize the embedded runtime; extra_pythonpath (nullable) is
+ * prepended to sys.path so the paddle_tpu package can be found.
+ * Returns 0 on success. */
+int pt_init(const char* extra_pythonpath);
+
+/* Last error message (valid until the next failing call). */
+const char* pt_last_error(void);
+
+/* Load an exported inference model directory; NULL on failure. */
+void* pt_engine_create(const char* model_dir);
+
+/* Run one forward pass.
+ *   names[i]   feed variable name
+ *   datas[i]   float32 buffer, row-major
+ *   shapes[i]  dimensions, ranks[i] entries
+ *   out_index  which fetch target to return
+ * Output pointers are owned by the handle and valid until the next
+ * pt_engine_run/pt_engine_destroy.  Returns 0 on success. */
+int pt_engine_run(void* handle, const char** names, const float** datas,
+                  const int64_t** shapes, const int32_t* ranks,
+                  int32_t n_inputs, int32_t out_index,
+                  const float** out_data, const int64_t** out_shape,
+                  int32_t* out_rank);
+
+void pt_engine_destroy(void* handle);
+
+/* No-op (the runtime stays resident for process lifetime, like the
+ * reference capi). */
+void pt_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H_ */

@@ -1,0 +1,224 @@
+// capi.cc — C inference API.
+//
+// Reference: paddle/capi (gradient_machine.h / matrix.h, ~2k LoC) lets C
+// programs load an exported model and run forward passes; the legacy engine
+// itself embeds CPython for data providers (paddle/utils/PythonUtil.h).
+// Here the same pattern: the C ABI embeds a Python interpreter and drives
+// paddle_tpu.inference.InferenceEngine, so C/C++ services get TPU inference
+// through one stable ABI with no Python in their own code.
+//
+//   pt_init(pythonpath)                         -- once per process
+//   h  = pt_engine_create("/path/to/model")     -- load exported model
+//   pt_engine_run(h, names, datas, shapes, ranks, n_inputs, out_index,
+//                 &out_data, &out_shape, &out_rank)
+//   pt_engine_destroy(h);  pt_shutdown()
+//
+// All outputs are float32 copies owned by the handle (valid until the next
+// run or destroy).  Errors: functions return NULL/-1; pt_last_error() gives
+// the Python traceback.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_error;
+std::mutex g_mu;
+
+void capture_py_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Engine {
+  PyObject* engine = nullptr;            // paddle_tpu.inference.InferenceEngine
+  std::vector<float> out_data;           // last fetched output copy
+  std::vector<int64_t> out_shape;
+};
+
+bool g_we_initialized = false;
+
+}  // namespace
+
+extern "C" {
+
+const char* pt_last_error() { return g_error.c_str(); }
+
+// Initialize the embedded interpreter.  extra_pythonpath may be NULL.
+int pt_init(const char* extra_pythonpath) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  if (extra_pythonpath && *extra_pythonpath) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(extra_pythonpath);
+    if (!sys_path || !p || PyList_Insert(sys_path, 0, p) != 0) {
+      capture_py_error();
+      rc = -1;
+    }
+    Py_XDECREF(p);
+  }
+  // PADDLE_TPU_PLATFORM overrides the jax backend (some platform plugins
+  // ignore the JAX_PLATFORMS env var; jax.config.update is authoritative)
+  const char* platform = getenv("PADDLE_TPU_PLATFORM");
+  if (rc == 0 && platform && *platform) {
+    std::string code =
+        std::string("import jax\n"
+                    "jax.config.update('jax_platforms', '") + platform + "')\n";
+    if (PyRun_SimpleString(code.c_str()) != 0) {
+      g_error = "failed to set jax platform";
+      rc = -1;
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void* pt_engine_create(const char* model_dir) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Engine* eng = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    capture_py_error();
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  PyObject* cls = PyObject_GetAttrString(mod, "InferenceEngine");
+  PyObject* obj =
+      cls ? PyObject_CallFunction(cls, "s", model_dir) : nullptr;
+  if (!obj) capture_py_error();
+  if (obj) {
+    eng = new Engine();
+    eng->engine = obj;
+  }
+  Py_XDECREF(cls);
+  Py_DECREF(mod);
+  PyGILState_Release(gil);
+  return eng;
+}
+
+// Run inference.  names[i]: feed name; datas[i]: float32 buffer;
+// shapes[i]: dims (ranks[i] entries).  out_index selects the fetch target.
+// On success fills out pointers (owned by the handle) and returns 0.
+int pt_engine_run(void* handle, const char** names, const float** datas,
+                  const int64_t** shapes, const int32_t* ranks,
+                  int32_t n_inputs, int32_t out_index,
+                  const float** out_data, const int64_t** out_shape,
+                  int32_t* out_rank) {
+  auto* eng = static_cast<Engine*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* np = nullptr;
+  PyObject* feed = nullptr;
+  PyObject* result = nullptr;
+  do {
+    np = PyImport_ImportModule("numpy");
+    if (!np) break;
+    feed = PyDict_New();
+    if (!feed) break;
+    bool feed_ok = true;
+    for (int32_t i = 0; i < n_inputs && feed_ok; i++) {
+      int64_t numel = 1;
+      for (int32_t d = 0; d < ranks[i]; d++) numel *= shapes[i][d];
+      // build a flat python list then reshape via numpy (avoids needing
+      // the numpy C API headers)
+      PyObject* lst = PyList_New(numel);
+      if (!lst) { feed_ok = false; break; }
+      for (int64_t j = 0; j < numel; j++) {
+        PyList_SET_ITEM(lst, j, PyFloat_FromDouble(datas[i][j]));
+      }
+      PyObject* shape = PyTuple_New(ranks[i]);
+      for (int32_t d = 0; d < ranks[i]; d++) {
+        PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(shapes[i][d]));
+      }
+      PyObject* arr = PyObject_CallMethod(np, "asarray", "Os", lst, "float32");
+      PyObject* reshaped =
+          arr ? PyObject_CallMethod(arr, "reshape", "O", shape) : nullptr;
+      if (!reshaped) feed_ok = false;
+      else PyDict_SetItemString(feed, names[i], reshaped);
+      Py_XDECREF(reshaped);
+      Py_XDECREF(arr);
+      Py_DECREF(shape);
+      Py_DECREF(lst);
+    }
+    if (!feed_ok) break;
+    result = PyObject_CallMethod(eng->engine, "run", "O", feed);
+    if (!result) break;
+    PyObject* item = PySequence_GetItem(result, out_index);
+    if (!item) break;
+    // normalize to a flat float64 list + shape tuple via numpy
+    PyObject* arr = PyObject_CallMethod(np, "asarray", "Os", item, "float32");
+    Py_DECREF(item);
+    if (!arr) break;
+    PyObject* shape = PyObject_GetAttrString(arr, "shape");
+    PyObject* flat = PyObject_CallMethod(arr, "flatten", nullptr);
+    PyObject* lst =
+        flat ? PyObject_CallMethod(flat, "tolist", nullptr) : nullptr;
+    if (shape && lst) {
+      Py_ssize_t rank = PyTuple_Size(shape);
+      eng->out_shape.resize(rank);
+      for (Py_ssize_t d = 0; d < rank; d++) {
+        eng->out_shape[d] =
+            PyLong_AsLongLong(PyTuple_GET_ITEM(shape, d));
+      }
+      Py_ssize_t numel = PyList_Size(lst);
+      eng->out_data.resize(numel);
+      for (Py_ssize_t j = 0; j < numel; j++) {
+        eng->out_data[j] =
+            static_cast<float>(PyFloat_AsDouble(PyList_GET_ITEM(lst, j)));
+      }
+      *out_data = eng->out_data.data();
+      *out_shape = eng->out_shape.data();
+      *out_rank = static_cast<int32_t>(rank);
+      rc = 0;
+    }
+    Py_XDECREF(lst);
+    Py_XDECREF(flat);
+    Py_XDECREF(shape);
+    Py_DECREF(arr);
+  } while (false);
+  if (rc != 0) capture_py_error();
+  Py_XDECREF(result);
+  Py_XDECREF(feed);
+  Py_XDECREF(np);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void pt_engine_destroy(void* handle) {
+  auto* eng = static_cast<Engine*>(handle);
+  if (!eng) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(eng->engine);
+  PyGILState_Release(gil);
+  delete eng;
+}
+
+void pt_shutdown() {
+  // Finalizing an interpreter that loaded jax/XLA can hang on backend
+  // threads; matching the reference capi (which never unloads), shutdown
+  // is a no-op and the OS reclaims at process exit.
+}
+
+}  // extern "C"

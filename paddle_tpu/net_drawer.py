@@ -1,0 +1,68 @@
+"""Graphviz dump of a Program (reference: fluid/net_drawer.py — draw the op
+graph for debugging; also utils/make_model_diagram.py for v1 configs).
+Pure-text DOT output; no graphviz dependency required to generate."""
+
+from .core.program import Parameter
+
+__all__ = ["draw_graph", "save_dot"]
+
+_OP_STYLE = 'shape=box, style="rounded,filled", fillcolor="#e8f0fe"'
+_VAR_STYLE = 'shape=ellipse, fillcolor="#fef7e0", style=filled'
+_PARAM_STYLE = 'shape=ellipse, fillcolor="#e6f4ea", style=filled'
+_DATA_STYLE = 'shape=ellipse, fillcolor="#fce8e6", style=filled'
+
+
+def _q(s):
+    return '"' + str(s).replace('"', '\\"') + '"'
+
+
+def draw_graph(program, block_idx=0, max_label=40):
+    """Return a DOT string of one block's op/var graph."""
+    block = program.block(block_idx)
+    lines = [
+        "digraph Program {",
+        "  rankdir=TB;",
+        "  node [fontsize=10];",
+    ]
+    seen_vars = set()
+
+    def var_node(name):
+        if name in seen_vars:
+            return
+        seen_vars.add(name)
+        var = block._find_var(name)
+        if isinstance(var, Parameter):
+            style = _PARAM_STYLE
+        elif var is not None and var.is_data:
+            style = _DATA_STYLE
+        else:
+            style = _VAR_STYLE
+        label = name
+        if var is not None and var.shape:
+            label += f"\\n{list(var.shape)}"
+        lines.append(f"  {_q('var_' + name)} [label={_q(label)}, {style}];")
+
+    for i, op in enumerate(block.ops):
+        marker = "*" if block.backward_index == i else ""
+        op_id = f"op_{block_idx}_{i}"
+        attrs = ", ".join(
+            f"{k}={v}" for k, v in op.attrs.items()
+            if not isinstance(v, (list, tuple)) or len(str(v)) < 12
+        )[:max_label]
+        label = f"{i}{marker}: {op.type}" + (f"\\n{attrs}" if attrs else "")
+        lines.append(f"  {_q(op_id)} [label={_q(label)}, {_OP_STYLE}];")
+        for n in op.input_names():
+            var_node(n)
+            lines.append(f"  {_q('var_' + n)} -> {_q(op_id)};")
+        for n in op.output_names():
+            var_node(n)
+            lines.append(f"  {_q(op_id)} -> {_q('var_' + n)};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def save_dot(program, path, block_idx=0):
+    dot = draw_graph(program, block_idx)
+    with open(path, "w") as f:
+        f.write(dot)
+    return path

@@ -74,21 +74,31 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     Pallas flash-attention kernel is used; with dropout (or
     ``use_fused=False``) it falls back to the composed softmax(QK^T)V."""
     d = queries.shape[-1]
-    if use_fused and not dropout_rate and d % num_heads == 0:
-        b, tq = queries.shape[0], queries.shape[1]
-        tk = keys.shape[1]
-        hd = d // num_heads
-        q4 = layers.reshape(queries, [0, tq, num_heads, hd])
-        k4 = layers.reshape(keys, [0, tk, num_heads, hd])
-        v4 = layers.reshape(values, [0, tk, num_heads, hd])
+    if d % num_heads != 0:
+        raise ValueError(f"hidden size {d} not divisible by num_heads "
+                         f"{num_heads}")
+    b, tq = queries.shape[0], queries.shape[1]
+    tk = keys.shape[1]
+    hd = d // num_heads
+    q4 = layers.reshape(queries, [0, tq, num_heads, hd])
+    k4 = layers.reshape(keys, [0, tk, num_heads, hd])
+    v4 = layers.reshape(values, [0, tk, num_heads, hd])
+    if use_fused and not dropout_rate:
         out = layers.flash_attention(q4, k4, v4)
         return layers.reshape(out, [0, tq, d])
-    scaled_q = layers.scale(queries, scale=float(d) ** -0.5)
-    product = layers.matmul(scaled_q, keys, transpose_y=True)
+    # composed path — identical multi-head math (per-head scale hd^-0.5),
+    # used when attention-weight dropout is requested
+    qh = layers.transpose(q4, [0, 2, 1, 3])  # [b, h, tq, hd]
+    kh = layers.transpose(k4, [0, 2, 1, 3])
+    vh = layers.transpose(v4, [0, 2, 1, 3])
+    scaled_q = layers.scale(qh, scale=float(hd) ** -0.5)
+    product = layers.matmul(scaled_q, kh, transpose_y=True)  # [b, h, tq, tk]
     weights = layers.softmax(product)
     if dropout_rate:
         weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    return layers.matmul(weights, values)
+    out = layers.matmul(weights, vh)  # [b, h, tq, hd]
+    out = layers.transpose(out, [0, 2, 1, 3])
+    return layers.reshape(out, [0, tq, d])
 
 
 def simple_attention(encoded_sequence, encoded_proj, decoder_state,

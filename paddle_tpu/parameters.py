@@ -1,0 +1,103 @@
+"""v2-style Parameters container with tar serialization.
+
+Reference: ``python/paddle/v2/parameters.py`` — ``Parameters`` wraps the
+model's named parameter values; ``to_tar`` (:328) writes one tar member per
+parameter (raw bytes + a pickled config header) and ``from_tar`` (:358)
+restores them; used for the v2 API's checkpoint format.
+
+Here Parameters is a live view over a Scope restricted to a Program's
+parameters; the tar layout is one ``<name>`` member holding a .npy payload
+(self-describing dtype/shape) — portable across hosts."""
+
+import io as _io
+import os
+import tarfile
+
+import numpy as np
+
+from .core.program import default_main_program
+from .core.scope import global_scope
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters:
+    def __init__(self, program=None, scope=None):
+        self.program = program or default_main_program()
+        self.scope = scope or global_scope()
+
+    def names(self):
+        return [p.name for p in self.program.all_parameters()]
+
+    def keys(self):
+        return self.names()
+
+    def __contains__(self, name):
+        return name in self.names()
+
+    def __getitem__(self, name):
+        return np.asarray(self.scope.get(name))
+
+    def get(self, name):
+        return self[name]
+
+    def __setitem__(self, name, value):
+        import jax.numpy as jnp
+
+        var = self.program.global_block().var(name)
+        arr = np.asarray(value)
+        if tuple(arr.shape) != tuple(var.shape):
+            raise ValueError(
+                f"shape mismatch for {name!r}: {arr.shape} vs {var.shape}"
+            )
+        self.scope.set(name, jnp.asarray(arr, dtype=var.dtype))
+
+    def set(self, name, value):
+        self[name] = value
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def __len__(self):
+        return len(self.names())
+
+    # -- tar serialization (v2/parameters.py:328,358) ----------------------
+    def to_tar(self, f):
+        """f: writable binary file object (matching the reference API)."""
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.names():
+                buf = _io.BytesIO()
+                np.save(buf, self[name], allow_pickle=False)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tar.addfile(info, _io.BytesIO(data))
+
+    def from_tar(self, f):
+        """Restore parameter values from a tar written by to_tar.  Unknown
+        members are ignored; missing parameters keep their values."""
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            names = set(self.names())
+            for member in tar.getmembers():
+                if member.name not in names:
+                    continue
+                payload = tar.extractfile(member).read()
+                arr = np.load(_io.BytesIO(payload), allow_pickle=False)
+                self[member.name] = arr
+        return self
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            self.to_tar(f)
+
+    @staticmethod
+    def load(path, program=None, scope=None):
+        p = Parameters(program, scope)
+        with open(path, "rb") as f:
+            p.from_tar(f)
+        return p
+
+
+def create(program=None, scope=None):
+    """v2 ``parameters.create(topology)`` analog."""
+    return Parameters(program, scope)

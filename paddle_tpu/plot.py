@@ -1,0 +1,66 @@
+"""Training-curve plotter (reference: ``python/paddle/v2/plot/plot.py``
+Ploter — collects per-title (step, value) series and renders them; falls
+back to appending CSV lines when matplotlib/display is unavailable, same as
+the reference's non-notebook path)."""
+
+__all__ = ["Ploter"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(float(value))
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    def __init__(self, *titles):
+        self.titles = list(titles)
+        self.data = {t: PlotData() for t in titles}
+        try:  # headless environments: record-only mode
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            self._plt = plt
+        except Exception:
+            self._plt = None
+
+    def append(self, title, step, value):
+        self.data[title].append(step, value)
+
+    def plot(self, path=None):
+        """Render all series; writes a PNG when ``path`` is given (or when
+        matplotlib exists), else writes ``<path>.csv``."""
+        if self._plt is not None:
+            fig, ax = self._plt.subplots()
+            for t in self.titles:
+                d = self.data[t]
+                ax.plot(d.step, d.value, label=t)
+            ax.legend()
+            ax.set_xlabel("step")
+            if path:
+                fig.savefig(path)
+            self._plt.close(fig)
+            return path
+        if path:
+            csv = path if path.endswith(".csv") else path + ".csv"
+            with open(csv, "w") as f:
+                for t in self.titles:
+                    d = self.data[t]
+                    for s, v in zip(d.step, d.value):
+                        f.write(f"{t},{s},{v}\n")
+            return csv
+        return None
+
+    def reset(self):
+        for d in self.data.values():
+            d.reset()

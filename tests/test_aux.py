@@ -1,0 +1,152 @@
+"""Auxiliary-subsystem tests: flags, check_nan_inf, net_drawer, Parameters
+tar io, plot, CLI (version/dump_config/merge_model), new datasets."""
+
+import io
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_flags_env_and_argv(monkeypatch):
+    from paddle_tpu import flags
+
+    assert pt.FLAGS.check_nan_inf is False
+    rest = flags.init_flags(["--check_nan_inf=true", "--unknown", "pos"])
+    try:
+        assert pt.FLAGS.check_nan_inf is True
+        assert rest == ["--unknown", "pos"]
+    finally:
+        pt.FLAGS.check_nan_inf = False
+
+
+def test_check_nan_inf_raises():
+    x = layers.data("x", shape=[2])
+    out = layers.log(x)  # log of negative -> nan
+    exe = pt.Executor()
+    pt.FLAGS.check_nan_inf = True
+    try:
+        with pytest.raises(FloatingPointError, match="NaN/Inf"):
+            exe.run(feed={"x": np.array([[-1.0, 2.0]], np.float32)},
+                    fetch_list=[out])
+    finally:
+        pt.FLAGS.check_nan_inf = False
+
+
+def test_net_drawer_dot():
+    x = layers.data("x", shape=[4])
+    y = layers.fc(input=x, size=3, act="relu")
+    loss = layers.mean(y)
+    dot = pt.net_drawer.draw_graph(pt.default_main_program())
+    assert dot.startswith("digraph")
+    assert "mul" in dot and "var_x" in dot
+
+
+def test_parameters_tar_roundtrip():
+    x = layers.data("x", shape=[4])
+    layers.fc(input=x, size=3)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    params = pt.parameters.create()
+    assert len(params) == 2
+    orig = {n: params[n].copy() for n in params}
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    # perturb, then restore
+    for n in params:
+        params[n] = np.zeros_like(orig[n])
+    buf.seek(0)
+    params.from_tar(buf)
+    for n in params:
+        np.testing.assert_array_equal(params[n], orig[n])
+
+
+def test_ploter_records():
+    p = pt.plot.Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    p.append("test", 0, 0.9)
+    assert p.data["train"].value == [1.0, 0.5]
+    p.reset()
+    assert p.data["train"].value == []
+
+
+def test_new_datasets_schema():
+    from paddle_tpu.dataset import flowers, imikolov, sentiment, voc2012
+
+    d = imikolov.build_dict()
+    sample = next(imikolov.train(d, n=5)())
+    assert len(sample) == 5 and all(isinstance(w, int) for w in sample)
+
+    ids, label = next(sentiment.train()())
+    assert label in (0, 1) and len(ids) > 0
+
+    img, lbl = next(flowers.train()())
+    assert img.shape == (3, 224, 224) and 0 <= lbl < flowers.CLASS_NUM
+
+    img, seg = next(voc2012.train()())
+    assert img.shape[0] == 3 and seg.shape == img.shape[1:]
+    assert seg.max() < voc2012.CLASS_NUM
+
+
+def _run_cli(*args, cwd=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", *args],
+        capture_output=True, text=True, env=env, cwd=cwd, timeout=240,
+    )
+
+
+def test_cli_version():
+    r = _run_cli("version")
+    assert r.returncode == 0, r.stderr
+    assert "paddle_tpu" in r.stdout
+
+
+def test_cli_dump_config_and_train(tmp_path):
+    cfg = tmp_path / "config.py"
+    cfg.write_text(
+        "import numpy as np\n"
+        "from paddle_tpu import layers, optimizer\n"
+        "def build():\n"
+        "    x = layers.data('x', shape=[4])\n"
+        "    y = layers.data('y', shape=[1])\n"
+        "    pred = layers.fc(input=x, size=1)\n"
+        "    cost = layers.mean(layers.square_error_cost(pred, y))\n"
+        "    optimizer.SGD(learning_rate=0.05).minimize(cost)\n"
+        "    return {'feed': [x, y], 'avg_cost': cost}\n"
+        "def train_reader():\n"
+        "    rng = np.random.RandomState(0)\n"
+        "    for _ in range(64):\n"
+        "        x = rng.rand(4).astype('float32')\n"
+        "        yield x, np.array([x.sum()], 'float32')\n"
+    )
+    r = _run_cli("dump_config", str(cfg))
+    assert r.returncode == 0, r.stderr
+    assert "mul" in r.stdout
+    r = _run_cli("dump_config", "--dot", str(cfg))
+    assert r.returncode == 0 and "digraph" in r.stdout
+    r = _run_cli("train", str(cfg), "--batch-size", "16",
+                 "--num-passes", "2")
+    assert r.returncode == 0, r.stderr
+    assert "pass 1 done" in r.stdout
+
+
+def test_cli_merge_model(tmp_path):
+    x = layers.data("x", shape=[4])
+    pred = layers.fc(input=x, size=2, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    model_dir = tmp_path / "model"
+    pt.io.save_inference_model(str(model_dir), ["x"], [pred], exe)
+    out = tmp_path / "bundle.tar"
+    r = _run_cli("merge_model", str(model_dir), str(out))
+    assert r.returncode == 0, r.stderr
+    assert out.exists() and out.stat().st_size > 0

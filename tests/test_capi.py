@@ -1,0 +1,69 @@
+"""C inference API test: export a model, compile the example C program
+against libpaddle_tpu_capi.so, run it as a real external process, and check
+the numbers (the reference's capi/examples pattern as a test)."""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.native import build as nbuild
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def capi_lib():
+    try:
+        return nbuild.build_capi()
+    except RuntimeError as e:
+        pytest.skip(f"capi unavailable: {e}")
+
+
+def test_capi_end_to_end(tmp_path, capi_lib):
+    # 1) export a deterministic linear model: y = x @ W (W = const 0.5)
+    x = layers.data("x", shape=[4])
+    pred = layers.fc(input=x, size=2, bias_attr=False,
+                     param_attr=pt.initializer.Constant(0.5))
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    model_dir = tmp_path / "model"
+    pt.io.save_inference_model(str(model_dir), ["x"], [pred], exe)
+
+    # 2) compile the example C program
+    exe_path = tmp_path / "infer"
+    include = os.path.join(REPO, "paddle_tpu", "native", "include")
+    src = os.path.join(REPO, "paddle_tpu", "native", "examples", "infer.c")
+    libdir = os.path.dirname(capi_lib)
+    cc = os.environ.get("CC", "gcc")
+    subprocess.run(
+        [cc, "-O2", src, f"-I{include}", f"-L{libdir}",
+         "-lpaddle_tpu_capi", "-o", str(exe_path),
+         f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True,
+    )
+
+    # 3) run it as an external process
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    env["LD_LIBRARY_PATH"] = (
+        libdir + ":" + sysconfig.get_config_var("LIBDIR")
+        + ":" + env.get("LD_LIBRARY_PATH", "")
+    )
+    vals = ["1", "2", "3", "4", "5", "6", "7", "8"]
+    r = subprocess.run(
+        [str(exe_path), REPO, str(model_dir), "x", "2", "4", *vals],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    out = np.array([float(v) for v in r.stdout.split()]).reshape(2, 2)
+    want = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.float32) @ np.full(
+        (4, 2), 0.5, np.float32
+    )
+    np.testing.assert_allclose(out, want, rtol=1e-5)

@@ -1,0 +1,456 @@
+"""Per-op tests for the groups not covered by the focused suites: losses,
+metrics, detection, CRF (vs brute force), CTC (vs brute force), beam
+search, elementwise/compare/logical, shape ops, random ops — extending the
+reference's one-test-per-op convention (SURVEY §4)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from tests.op_test import check_grad, check_output, run_op
+
+
+# ------------------------------------------------------------------ losses
+def test_hinge_loss():
+    logits = np.array([[0.5], [-0.3], [2.0]], np.float32)
+    labels = np.array([[1.0], [0.0], [1.0]], np.float32)
+    y = labels * 2 - 1
+    expected = np.maximum(1 - logits * y, 0)
+    check_output("hinge_loss", {"Logits": logits, "Labels": labels},
+                 {"Loss": expected})
+    check_grad("hinge_loss", {"Logits": logits, "Labels": labels},
+               wrt="Logits", output="Loss")
+
+
+def test_huber_loss():
+    x = np.random.RandomState(0).randn(8, 1).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+    r = y - x
+    d = 1.5
+    expected = np.where(np.abs(r) <= d, 0.5 * r * r, d * (np.abs(r) - 0.5 * d))
+    check_output("huber_loss", {"X": x, "Y": y}, {"Out": expected},
+                 attrs={"delta": d})
+    check_grad("huber_loss", {"X": x, "Y": y}, wrt="X", attrs={"delta": d})
+
+
+def test_log_loss():
+    p = np.array([[0.2], [0.8]], np.float32)
+    l = np.array([[0.0], [1.0]], np.float32)
+    eps = 1e-4
+    expected = -l * np.log(p + eps) - (1 - l) * np.log(1 - p + eps)
+    check_output("log_loss", {"Predicted": p, "Labels": l},
+                 {"Loss": expected}, attrs={"epsilon": eps})
+    check_grad("log_loss", {"Predicted": p, "Labels": l}, wrt="Predicted",
+               output="Loss", attrs={"epsilon": eps})
+
+
+def test_rank_loss_and_margin_rank_loss():
+    rng = np.random.RandomState(2)
+    left = rng.randn(6, 1).astype(np.float32)
+    right = rng.randn(6, 1).astype(np.float32)
+    label = (rng.rand(6, 1) > 0.5).astype(np.float32)
+    d = left - right
+    expected = np.log1p(np.exp(d)) - label * d
+    check_output("rank_loss", {"Label": label, "Left": left, "Right": right},
+                 {"Out": expected})
+    y = label * 2 - 1  # margin_rank uses +-1 labels
+    expected2 = np.maximum(-y * (left - right) + 0.1, 0)
+    check_output("margin_rank_loss",
+                 {"Label": y, "X1": left, "X2": right},
+                 {"Out": expected2}, attrs={"margin": 0.1})
+
+
+def test_modified_huber_loss():
+    x = np.array([[-2.0], [-0.5], [0.5], [2.0]], np.float32)
+    yb = np.array([[0], [1], [1], [0]], np.float32)
+    y = yb * 2 - 1
+    z = (x * y).ravel()
+    expected = np.where(z < -1, -4 * z, np.maximum(1 - z, 0) ** 2).reshape(-1, 1)
+    check_output("modified_huber_loss", {"X": x, "Y": yb}, {"Out": expected})
+
+
+def test_squared_l2_distance_and_norm():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(4, 5).astype(np.float32)
+    got = run_op("squared_l2_distance", {"X": x, "Y": y})
+    np.testing.assert_allclose(
+        got["Out"].ravel(), ((x - y) ** 2).sum(1), rtol=1e-5)
+    got = run_op("squared_l2_norm", {"X": x})
+    np.testing.assert_allclose(got["Out"].ravel(), [(x ** 2).sum()],
+                               rtol=1e-5)
+
+
+def test_nce_deterministic_with_key():
+    import jax
+
+    rng = np.random.RandomState(4)
+    inp = rng.randn(3, 8).astype(np.float32)
+    w = rng.randn(20, 8).astype(np.float32)
+    lbl = np.array([[1], [5], [7]], np.int64)
+    attrs = {"num_neg_samples": 4, "num_total_classes": 20,
+             "_key": jax.random.PRNGKey(0)}
+    a = run_op("nce", {"Input": inp, "Label": lbl, "Weight": w}, attrs)
+    b = run_op("nce", {"Input": inp, "Label": lbl, "Weight": w}, attrs)
+    np.testing.assert_array_equal(a["Cost"], b["Cost"])
+    assert np.isfinite(a["Cost"]).all()
+
+
+# ----------------------------------------------------------------- metrics
+def test_accuracy_op():
+    indices = np.array([[0, 2], [1, 3], [4, 0]], np.int64)
+    label = np.array([[2], [0], [4]], np.int64)
+    got = run_op("accuracy", {"Out": indices.astype(np.float32),
+                              "Indices": indices, "Label": label})
+    np.testing.assert_allclose(got["Accuracy"], [2 / 3], rtol=1e-6)
+    assert got["Correct"][0] == 2 and got["Total"][0] == 3
+
+
+def test_auc_perfect_and_random():
+    probs = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.9, 0.1]],
+                     np.float32)
+    label = np.array([[1], [0], [1], [0]], np.int64)
+    got = run_op("auc", {"Out": probs, "Label": label})
+    assert got["AUC"][0] > 0.99  # perfectly separable
+    label_bad = np.array([[0], [1], [0], [1]], np.int64)
+    got = run_op("auc", {"Out": probs, "Label": label_bad})
+    assert got["AUC"][0] < 0.01
+
+
+def test_precision_recall_op():
+    indices = np.array([[0], [0], [1], [1]], np.int64)
+    labels = np.array([[0], [1], [1], [1]], np.int64)
+    got = run_op(
+        "precision_recall",
+        {"Indices": indices, "Labels": labels},
+        attrs={"class_number": 2},
+    )
+    # class 0: tp=1 fp=1 fn=0 -> precision .5 recall 1
+    # class 1: tp=2 fp=0 fn=1 -> precision 1 recall 2/3
+    macro_p = (0.5 + 1.0) / 2
+    np.testing.assert_allclose(got["BatchMetrics"][0], macro_p, rtol=1e-5)
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.9], [0.2], [0.5]], np.float32)
+    label = np.array([[1], [0], [0]], np.int64)
+    qid = np.array([[0], [0], [0]], np.int64)
+    got = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": qid})
+    # positive item ranked above both negatives: 2 correct pairs, 0 wrong
+    np.testing.assert_allclose(got["PositivePair"], [2.0])
+    np.testing.assert_allclose(got["NegativePair"], [0.0])
+
+
+def test_edit_distance_op():
+    hyp = np.array([[1, 2, 3, 0]], np.int64)
+    ref = np.array([[1, 3, 3, 2]], np.int64)
+    got = run_op(
+        "edit_distance",
+        {"Hyps": hyp, "Refs": ref,
+         "HypsLength": np.array([3], np.int64),
+         "RefsLength": np.array([4], np.int64)},
+    )
+    # hyp [1,2,3] vs ref [1,3,3,2]: distance 2
+    np.testing.assert_allclose(got["Out"].ravel(), [2.0])
+
+
+# --------------------------------------------------------------- detection
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2]], np.float32)
+    got = run_op("iou_similarity", {"X": a, "Y": b})
+    np.testing.assert_allclose(got["Out"], [[1 / 7, 1.0]], rtol=1e-5)
+
+
+def test_bipartite_match():
+    dist = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+    got = run_op("bipartite_match", {"DistMat": dist})
+    np.testing.assert_array_equal(got["ColToRowMatchIndices"], [[0, 1]])
+
+
+def test_prior_box_shapes():
+    image = np.zeros((1, 3, 32, 32), np.float32)
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    got = run_op(
+        "prior_box", {"Input": feat, "Image": image},
+        attrs={"min_sizes": [4.0], "max_sizes": [], "aspect_ratios": [1.0],
+               "variances": [0.1, 0.1, 0.2, 0.2], "flip": False,
+               "clip": True},
+    )
+    assert got["Boxes"].shape[:2] == (4, 4)
+    assert got["Boxes"].min() >= 0 and got["Boxes"].max() <= 1
+
+
+def test_roi_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)  # batch_id, x1,y1,x2,y2
+    got = run_op(
+        "roi_pool", {"X": x, "ROIs": rois},
+        attrs={"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+    )
+    np.testing.assert_allclose(got["Out"][0, 0], [[5, 7], [13, 15]])
+
+
+# ---------------------------------------------------------------- crf / ctc
+def _brute_crf_nll(emission, transition, labels, length):
+    """Enumerate all paths for one sequence (tiny n, t)."""
+    t, n = emission.shape
+    start, end, trans = transition[0], transition[1], transition[2:]
+
+    def path_score(path):
+        s = start[path[0]] + emission[0, path[0]]
+        for i in range(1, length):
+            s += trans[path[i - 1], path[i]] + emission[i, path[i]]
+        return s + end[path[length - 1]]
+
+    scores = [
+        path_score(p) for p in itertools.product(range(n), repeat=length)
+    ]
+    logz = np.log(np.sum(np.exp(np.array(scores))))
+    return logz - path_score(labels)
+
+
+def test_linear_chain_crf_vs_brute_force():
+    rng = np.random.RandomState(5)
+    t, n = 4, 3
+    emission = rng.randn(1, t, n).astype(np.float32)
+    transition = rng.randn(n + 2, n).astype(np.float32) * 0.5
+    labels = np.array([[0, 2, 1, 0]], np.int64)
+    got = run_op(
+        "linear_chain_crf",
+        {"Emission": emission, "Transition": transition, "Label": labels,
+         "Length": np.array([t], np.int32)},
+    )
+    want = _brute_crf_nll(emission[0], transition, labels[0], t)
+    np.testing.assert_allclose(got["LogLikelihood"].ravel(), [want],
+                               rtol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(6)
+    t, n = 4, 3
+    emission = rng.randn(1, t, n).astype(np.float32)
+    transition = rng.randn(n + 2, n).astype(np.float32)
+    got = run_op(
+        "crf_decoding",
+        {"Emission": emission, "Transition": transition,
+         "Length": np.array([t], np.int32)},
+    )
+    start, end, trans = transition[0], transition[1], transition[2:]
+    best, best_score = None, -np.inf
+    for p in itertools.product(range(n), repeat=t):
+        s = start[p[0]] + emission[0, 0, p[0]]
+        for i in range(1, t):
+            s += trans[p[i - 1], p[i]] + emission[0, i, p[i]]
+        s += end[p[-1]]
+        if s > best_score:
+            best, best_score = p, s
+    np.testing.assert_array_equal(got["ViterbiPath"][0], best)
+
+
+def _brute_ctc_nll(logits, labels, blank):
+    """Sum probability over all alignments (tiny T)."""
+    t, v = logits.shape
+    logp = logits - np.log(np.sum(np.exp(logits), axis=1, keepdims=True))
+
+    def collapse(seq):
+        out = []
+        prev = None
+        for s in seq:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        return tuple(out)
+
+    total = 0.0
+    for seq in itertools.product(range(v), repeat=t):
+        if collapse(seq) == tuple(labels):
+            total += np.exp(sum(logp[i, s] for i, s in enumerate(seq)))
+    return -np.log(total)
+
+
+def test_warpctc_vs_brute_force():
+    rng = np.random.RandomState(7)
+    t, v = 4, 3  # vocab {0,1}, blank=2
+    logits = rng.randn(1, t, v).astype(np.float32)
+    labels = np.array([[0, 1]], np.int64)
+    got = run_op(
+        "warpctc",
+        {"Logits": logits, "Label": labels,
+         "LogitsLength": np.array([t], np.int64),
+         "LabelLength": np.array([2], np.int64)},
+        attrs={"blank": 2},
+    )
+    want = _brute_ctc_nll(logits[0], [0, 1], blank=2)
+    np.testing.assert_allclose(got["Loss"].ravel(), [want], rtol=1e-4)
+
+
+def test_ctc_align():
+    x = np.array([[0, 0, 1, 1, 2, 0, 2, 2]], np.int64)
+    got = run_op("ctc_align", {"Input": x,
+                               "InputLength": np.array([8], np.int64)},
+                 attrs={"blank": 0})
+    # collapse repeats then remove blanks: [1, 2, 2]
+    out = got["Output"][0]
+    np.testing.assert_array_equal(out[:3], [1, 2, 2])
+
+
+# -------------------------------------------------------------- beam search
+def test_beam_search_step():
+    pre_ids = np.zeros((1, 2), np.int64)
+    pre_scores = np.array([[0.0, -1e9]], np.float32)  # beam 1 dead at t=0
+    scores = np.log(np.array(
+        [[[0.1, 0.7, 0.2], [0.3, 0.3, 0.4]]], np.float32))
+    got = run_op(
+        "beam_search",
+        {"PreIds": pre_ids, "PreScores": pre_scores, "Scores": scores},
+        attrs={"beam_size": 2, "end_id": 3},
+    )
+    # all mass comes from beam 0: top2 tokens are 1 (0.7) and 2 (0.2)
+    np.testing.assert_array_equal(got["SelectedIds"][0], [1, 2])
+    np.testing.assert_array_equal(got["ParentIdx"][0], [0, 0])
+
+
+def test_top_k():
+    x = np.array([[3.0, 1.0, 4.0, 1.5]], np.float32)
+    got = run_op("top_k", {"X": x}, attrs={"k": 2})
+    np.testing.assert_allclose(got["Out"], [[4.0, 3.0]])
+    np.testing.assert_array_equal(got["Indices"], [[2, 0]])
+
+
+# ------------------------------------------------- elementwise / activations
+@pytest.mark.parametrize("op,fn", [
+    ("elementwise_add", np.add),
+    ("elementwise_div", np.divide),
+    ("elementwise_min", np.minimum),
+    ("elementwise_pow", np.power),
+])
+def test_elementwise_ops(op, fn):
+    rng = np.random.RandomState(8)
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    y = rng.rand(3, 4).astype(np.float32) + 0.5
+    check_output(op, {"X": x, "Y": y}, {"Out": fn(x, y)})
+    check_grad(op, {"X": x, "Y": y}, wrt="X")
+
+
+def test_elementwise_broadcast_axis():
+    x = np.random.RandomState(9).rand(2, 3, 4).astype(np.float32)
+    y = np.random.RandomState(10).rand(3).astype(np.float32)
+    got = run_op("elementwise_add", {"X": x, "Y": y}, attrs={"axis": 1})
+    np.testing.assert_allclose(got["Out"], x + y[None, :, None], rtol=1e-6)
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("exp", np.exp),
+    ("log", np.log),
+    ("sqrt", np.sqrt),
+    ("reciprocal", np.reciprocal),
+    ("softplus", lambda x: np.log1p(np.exp(x))),
+    ("softsign", lambda x: x / (1 + np.abs(x))),
+    ("hard_sigmoid", lambda x: np.clip(0.2 * x + 0.5, 0, 1)),
+])
+def test_more_activations(op, fn):
+    x = np.random.RandomState(11).rand(4, 5).astype(np.float32) + 0.5
+    check_output(op, {"X": x}, {"Out": fn(x)}, atol=1e-5)
+    check_grad(op, {"X": x}, wrt="X")
+
+
+# ----------------------------------------------------- compare / logical
+def test_compare_and_logical_ops():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    y = np.array([2.0, 2.0, 2.0], np.float32)
+    assert run_op("less_than", {"X": x, "Y": y})["Out"].tolist() == [
+        True, False, False]
+    assert run_op("greater_equal", {"X": x, "Y": y})["Out"].tolist() == [
+        False, True, True]
+    a = np.array([True, False, True])
+    b = np.array([True, True, False])
+    assert run_op("logical_and", {"X": a, "Y": b})["Out"].tolist() == [
+        True, False, False]
+    assert run_op("logical_xor", {"X": a, "Y": b})["Out"].tolist() == [
+        False, True, True]
+    assert run_op("logical_not", {"X": a})["Out"].tolist() == [
+        False, True, False]
+
+
+# -------------------------------------------------------------- shape ops
+def test_shape_manipulation_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    got = run_op("transpose", {"X": x}, attrs={"axis": [0, 2, 1]})
+    np.testing.assert_array_equal(got["Out"], x.transpose(0, 2, 1))
+    got = run_op("expand", {"X": x[:1]}, attrs={"expand_times": [2, 1, 1]})
+    np.testing.assert_array_equal(got["Out"], np.tile(x[:1], (2, 1, 1)))
+    got = run_op("pad", {"X": x[0]},
+                 attrs={"paddings": [1, 0, 0, 2], "pad_value": -1.0})
+    assert got["Out"].shape == (4, 6)
+    assert (got["Out"][0] == -1).all()
+    got = run_op("crop", {"X": x[0]}, attrs={"offsets": [1, 1],
+                                             "shape": [2, 2]})
+    np.testing.assert_array_equal(got["Out"], x[0][1:3, 1:3])
+    got = run_op("gather", {"X": x[0], "Index": np.array([2, 0])})
+    np.testing.assert_array_equal(got["Out"], x[0][[2, 0]])
+    got = run_op("scatter", {"X": np.zeros((3, 4), np.float32),
+                             "Ids": np.array([1]),
+                             "Updates": np.ones((1, 4), np.float32)})
+    assert got["Out"][1].sum() == 4
+    got = run_op("one_hot", {"X": np.array([[1], [3]], np.int64)},
+                 attrs={"depth": 4})
+    np.testing.assert_array_equal(
+        got["Out"], [[0, 1, 0, 0], [0, 0, 0, 1]])
+
+
+def test_cast_concat_split():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    got = run_op("cast", {"X": x}, attrs={"out_dtype": "int32"})
+    assert got["Out"].dtype == np.int32
+    got = run_op("concat", {"X": [x, x]}, attrs={"axis": 1})
+    assert got["Out"].shape == (2, 6)
+    got = run_op("split", {"X": x}, attrs={"num": 3, "axis": 1})
+    assert len(got["Out"]) == 3 and got["Out"][0].shape == (2, 1)
+
+
+def test_multiplex():
+    ids = np.array([[1], [0]], np.int32)
+    a = np.full((2, 3), 1.0, np.float32)
+    b = np.full((2, 3), 2.0, np.float32)
+    got = run_op("multiplex", {"Ids": ids, "X": [a, b]})
+    np.testing.assert_array_equal(got["Out"][0], b[0])
+    np.testing.assert_array_equal(got["Out"][1], a[1])
+
+
+# -------------------------------------------------------------- random ops
+def test_random_ops_deterministic_and_distribution():
+    import jax
+
+    key = jax.random.PRNGKey(42)
+    a = run_op("gaussian_random", {}, attrs={"shape": [1000], "mean": 1.0,
+                                             "std": 2.0, "_key": key})
+    b = run_op("gaussian_random", {}, attrs={"shape": [1000], "mean": 1.0,
+                                             "std": 2.0, "_key": key})
+    np.testing.assert_array_equal(a["Out"], b["Out"])
+    assert abs(a["Out"].mean() - 1.0) < 0.3
+    assert abs(a["Out"].std() - 2.0) < 0.3
+    u = run_op("uniform_random", {}, attrs={"shape": [1000], "min": -1.0,
+                                            "max": 1.0, "_key": key})
+    assert u["Out"].min() >= -1 and u["Out"].max() <= 1
+    tg = run_op("truncated_gaussian_random", {},
+                attrs={"shape": [1000], "mean": 0.0, "std": 1.0, "_key": key})
+    assert np.abs(tg["Out"]).max() <= 2.0 + 1e-5
+
+
+def test_norm_and_spp_and_conv_shift():
+    x = np.random.RandomState(12).rand(2, 3, 4).astype(np.float32)
+    got = run_op("norm", {"X": x}, attrs={"axis": 1, "epsilon": 1e-10})
+    np.testing.assert_allclose(
+        got["Out"], x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10),
+        rtol=1e-5)
+    img = np.random.RandomState(13).rand(1, 2, 4, 4).astype(np.float32)
+    got = run_op("spp", {"X": img}, attrs={"pyramid_height": 2,
+                                           "pooling_type": "max"})
+    assert got["Out"].shape == (1, 2 * (1 + 4))
+    xs = np.random.RandomState(14).rand(2, 5).astype(np.float32)
+    ker = np.random.RandomState(15).rand(2, 3).astype(np.float32)
+    got = run_op("conv_shift", {"X": xs, "Y": ker})
+    assert got["Out"].shape == (2, 5)
