@@ -168,9 +168,13 @@ def cmd_merge_model(args):
 def cmd_bench(args):
     import runpy
 
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    if not os.path.exists(path):
+        raise SystemExit(
+            "bench.py not found next to the package — the bench command is "
+            "only available from a source checkout")
     sys.argv = ["bench.py"]
-    runpy.run_path(os.path.join(os.path.dirname(__file__), "..", "bench.py"),
-                   run_name="__main__")
+    runpy.run_path(path, run_name="__main__")
     return 0
 
 

@@ -18,7 +18,6 @@ import uuid
 
 from . import rpc
 from .store import InMemStore
-from ..dataset.common import read_records
 
 SNAPSHOT_KEY = "master/taskqueues"
 
@@ -245,12 +244,8 @@ class MasterClient:
             def gen(paths):
                 from ..native import recordio
 
-                for entry in paths:
-                    p, off = entry if isinstance(entry, (list, tuple)) else (entry, -1)
-                    if off < 0:
-                        yield from read_records(p)
-                    else:
-                        yield from recordio.read_chunk(p, off)
+                for p, off in paths:
+                    yield from recordio.read_chunk(p, off)
 
             self._task = task
             self._records = gen(task["paths"])

@@ -57,7 +57,10 @@ def init_flags(argv):
             body = tok[2:]
             if "=" in body:
                 k, v = body.split("=", 1)
-            elif i + 1 < len(argv) and body in _DEFS:
+            elif (i + 1 < len(argv) and body in _DEFS
+                  and _DEFS[body]["type"] is not _parse_bool):
+                # gflags semantics: only non-bool flags take the next
+                # token as a value; a bare bool flag means "true"
                 k, v = body, argv[i + 1]
                 i += 1
             else:

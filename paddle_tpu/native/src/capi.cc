@@ -54,6 +54,7 @@ struct Engine {
 };
 
 bool g_we_initialized = false;
+PyThreadState* g_saved_tstate = nullptr;
 
 }  // namespace
 
@@ -92,6 +93,12 @@ int pt_init(const char* extra_pythonpath) {
     }
   }
   PyGILState_Release(gil);
+  if (g_we_initialized && !g_saved_tstate) {
+    // Py_InitializeEx leaves the initializing thread owning the GIL even
+    // after the matching PyGILState_Release; drop it so other threads'
+    // PyGILState_Ensure (pt_engine_*) can acquire it.
+    g_saved_tstate = PyEval_SaveThread();
+  }
   return rc;
 }
 

@@ -10,9 +10,13 @@ entire distributed-compute stack (SURVEY §2.3/2.4):
   pmean etc. inside the compiled program.
 * ParallelNeuralNetwork per-layer device placement           → parameter
   partition specs (tensor parallelism).
-* (NEW capability, absent in the 2018 reference) sequence/context
+* (NEW capabilities, absent in the 2018 reference) sequence/context
   parallelism: ring attention over the sequence axis via shard_map +
-  ppermute.
+  ppermute; pipeline parallelism: GPipe microbatch schedule as a scan
+  (pipeline.py); expert parallelism: all_to_all MoE dispatch (moe.py).
+
+Mesh axis conventions: dp (data) · tp (tensor) · pp (pipeline) ·
+sp (sequence/context) · ep (expert).
 """
 
 from .mesh import make_mesh, single_host_mesh
@@ -24,10 +28,13 @@ from .api import (
     P,
 )
 from .ring_attention import ring_attention, blockwise_attention
+from .pipeline import pipeline, stack_stage_params
+from .moe import init_moe_params, moe_ffn
 from . import sparse
 
 __all__ = [
     "make_mesh", "single_host_mesh", "compile_shardings", "data_parallel",
     "shard_parameter", "replicate", "P", "ring_attention",
-    "blockwise_attention", "sparse",
+    "blockwise_attention", "pipeline", "stack_stage_params",
+    "init_moe_params", "moe_ffn", "sparse",
 ]

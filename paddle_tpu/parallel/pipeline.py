@@ -1,0 +1,101 @@
+"""Pipeline parallelism (mesh axis ``pp``).
+
+The reference's closest ancestor is per-layer device placement with
+pipeline threads (``ParallelNeuralNetwork.cpp:45-47`` — layers carry a
+``deviceId``, a task queue ships TASK_FORWARD/TASK_BACKWARD between
+compute threads).  The TPU-native design has no threads and no queues:
+the repeated stage is expressed ONCE, its parameters are stacked with a
+leading ``[pp]`` axis sharded over the mesh, and a ``lax.scan`` of
+"pipeline ticks" inside ``shard_map`` moves microbatch activations to
+the next stage with ``ppermute`` — GPipe scheduling as a pure, jittable,
+differentiable program (the backward pass is the autodiff transpose of
+the scan, so 1F1B-style reverse ticks come for free).
+
+Constraint (inherent to the stacked-stage formulation): every stage maps
+activations of one fixed shape to the same shape — the transformer-block
+regime.  Unequal first/last layers (embed / head) run outside the
+pipelined region.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline", "stack_stage_params"]
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage parameter pytrees (all the same structure) into one
+    pytree whose leaves carry a leading ``[pp]`` axis — shard that axis over
+    the ``pp`` mesh axis (``P('pp', ...)``) so each device owns one stage."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline(stage_fn, stacked_params, x, mesh, axis_name="pp",
+             num_microbatches=None, batch_axis=None):
+    """Run ``num_stages`` copies of ``stage_fn`` as a GPipe pipeline.
+
+    stage_fn(params, h) -> h        one stage, shape-preserving
+    stacked_params                  pytree, leaves ``[pp, ...]`` (see
+                                    ``stack_stage_params``)
+    x                               ``[batch, ...]`` activations
+    num_microbatches                must divide batch; default = pp
+    batch_axis                      optional mesh axis name to ALSO shard
+                                    the microbatch dim over (dp×pp: each
+                                    pipeline replica handles its batch
+                                    shard; grad psum over dp comes from
+                                    the shard_map transpose)
+
+    Returns ``[batch, ...]`` outputs (replicated over ``pp``, sharded over
+    ``batch_axis`` if given).  Total ticks = num_microbatches + pp - 1;
+    the bubble fraction shrinks as microbatches grow, exactly the GPipe
+    trade-off.
+    """
+    pp = mesh.shape[axis_name]
+    m = num_microbatches or pp
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    mb = b // m
+    xm = x.reshape(m, mb, *x.shape[1:])
+
+    def local_fn(params, xm):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        idx = jax.lax.axis_index(axis_name)
+        fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            state, out_buf = carry
+            # stage 0 ingests microbatch t while one remains
+            feed_t = jnp.clip(t, 0, m - 1)
+            state = jnp.where(idx == 0, xm[feed_t], state)
+            h = stage_fn(params, state)
+            # last stage emits microbatch t-(pp-1)
+            out_t = t - (pp - 1)
+            emit = (idx == pp - 1) & (out_t >= 0)
+            slot = jnp.clip(out_t, 0, m - 1)
+            out_buf = jnp.where(
+                emit, out_buf.at[slot].set(h), out_buf)
+            # rotate activations one stage forward over ICI
+            h = jax.lax.ppermute(h, axis_name, fwd)
+            return (h, out_buf), None
+
+        state0 = jnp.zeros_like(xm[0])
+        (_, out_buf), _ = jax.lax.scan(
+            tick, (state0, jnp.zeros_like(xm)), jnp.arange(m + pp - 1))
+        # only the last stage holds real outputs; replicate via masked psum
+        out_buf = jax.lax.psum(
+            jnp.where(idx == pp - 1, out_buf, jnp.zeros_like(out_buf)),
+            axis_name)
+        return out_buf
+
+    xspec = P(None, batch_axis) if batch_axis else P()
+    fn = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(axis_name), xspec), out_specs=xspec,
+        check_vma=False,
+    )
+    out = fn(stacked_params, xm)
+    return out.reshape(b, *x.shape[1:])
