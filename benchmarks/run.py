@@ -1,0 +1,128 @@
+"""Benchmark sweep over the reference's published configs (SURVEY §6,
+BASELINE.md; reference scripts: benchmark/paddle/image/{alexnet,googlenet,
+resnet,vgg,smallnet_mnist_cifar}.py + benchmark/paddle/rnn/rnn.py and
+run.sh batch-size sweeps).
+
+Each row trains a few steps of the config on synthetic device-resident data
+and reports ms/batch and img|seq/s next to the reference's published number
+for the same config, so a single run reproduces the BASELINE tables on
+whatever accelerator `jax.devices()` offers.
+
+Usage:
+    python benchmarks/run.py                 # all configs, default batches
+    python benchmarks/run.py alexnet resnet  # a subset
+    BENCH_STEPS=20 python benchmarks/run.py  # more timing steps
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import timed_steps
+
+# Benchmark rows: name -> (builder kwargs, batch, image/seq shape, reference
+# number from BASELINE.md for context).
+CONFIGS = {
+    "smallnet": dict(batch=64, image=(3, 32, 32), classes=10,
+                     ref="10.46 ms/batch bs64 K40m"),
+    "alexnet": dict(batch=128, image=(3, 227, 227), classes=1000,
+                    ref="334 ms/batch bs128 K40m; 399 img/s bs64 Xeon"),
+    "googlenet": dict(batch=128, image=(3, 224, 224), classes=1000,
+                      ref="1149 ms/batch bs128 K40m; 250 img/s bs64 Xeon"),
+    "vgg": dict(batch=64, image=(3, 224, 224), classes=1000,
+                ref="28.46 img/s bs64 Xeon (VGG-19)", depth=19),
+    "resnet": dict(batch=64, image=(3, 224, 224), classes=1000,
+                   ref="81.69 img/s bs64 Xeon (ResNet-50)", depth=50),
+    "lstm": dict(batch=64, seq_len=100, hid=512, dict_dim=10000, classes=2,
+                 ref="184 ms/batch bs64 h512 K40m"),
+}
+
+
+def _build(name, cfg, dtype):
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        if name == "lstm":
+            outs = models.text_classification.build(
+                dict_dim=cfg["dict_dim"], class_dim=cfg["classes"],
+                hid_dim=cfg["hid"], max_len=cfg["seq_len"])
+        elif name in ("vgg", "resnet"):
+            mod = getattr(models, name)
+            outs = mod.build(depth=cfg["depth"], class_dim=cfg["classes"],
+                             image_shape=cfg["image"], dtype=dtype)
+        else:
+            mod = getattr(models, name)
+            outs = mod.build(class_dim=cfg["classes"],
+                             image_shape=cfg["image"], dtype=dtype)
+    return main, startup, outs
+
+
+def _feed(name, cfg, dtype, rng):
+    import jax
+    import jax.numpy as jnp
+
+    batch = cfg["batch"]
+    if name == "lstm":
+        words = rng.integers(0, cfg["dict_dim"],
+                             size=(batch, cfg["seq_len"])).astype(np.int64)
+        lens = np.full((batch,), cfg["seq_len"], np.int32)
+        label = rng.integers(0, cfg["classes"], (batch, 1)).astype(np.int64)
+        return {"words": jax.device_put(jnp.asarray(words)),
+                "words@LENGTH": jax.device_put(jnp.asarray(lens)),
+                "label": jax.device_put(jnp.asarray(label))}
+    img = rng.random(size=(batch, *cfg["image"]), dtype=np.float32)
+    label = rng.integers(0, cfg["classes"], (batch, 1)).astype(np.int64)
+    jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    return {"img": jax.device_put(jnp.asarray(img, dtype=jdtype)),
+            "label": jax.device_put(jnp.asarray(label))}
+
+
+def bench_one(name, steps, warmup, dtype):
+    import paddle_tpu as pt
+
+    cfg = CONFIGS[name]
+    main, startup, outs = _build(name, cfg, dtype)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    feed = _feed(name, cfg, dtype, rng)
+    fetch = [outs["avg_cost"]]
+    dt, cost = timed_steps(exe, main, feed, fetch, steps, warmup)
+    assert np.isfinite(cost[0]).all()
+    ms = dt / steps * 1000.0
+    return {
+        "config": name,
+        "batch": cfg["batch"],
+        "ms_per_batch": round(ms, 2),
+        "items_per_sec": round(cfg["batch"] / (ms / 1000.0), 2),
+        "dtype": dtype,
+        "reference": cfg["ref"],
+    }
+
+
+def main(argv):
+    names = [a for a in argv if not a.startswith("-")] or list(CONFIGS)
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    import jax
+
+    print(f"# devices: {jax.devices()}", file=sys.stderr)
+    for name in names:
+        if name not in CONFIGS:
+            print(f"unknown config {name!r}; have {sorted(CONFIGS)}",
+                  file=sys.stderr)
+            return 1
+        row = bench_one(name, steps, warmup, dtype)
+        print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

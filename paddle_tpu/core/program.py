@@ -102,6 +102,27 @@ class Variable:
     def __truediv__(self, other):
         return self._binary(other, "elementwise_div")
 
+    __radd__ = __add__
+    __rmul__ = __mul__
+
+    def __rtruediv__(self, other):
+        from .. import layers
+
+        const = layers.fill_constant(
+            shape=[1], dtype=self.dtype, value=float(other)
+        )
+        return layers.elementwise_div(const, self)
+
+    def __rsub__(self, other):
+        from .. import layers
+
+        return layers.scale(self, scale=-1.0, bias=float(other))
+
+    def __neg__(self):
+        from .. import layers
+
+        return layers.scale(self, scale=-1.0)
+
 
 class Parameter(Variable):
     """A trainable persistable variable (fluid/framework.py:931)."""

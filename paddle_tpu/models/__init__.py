@@ -5,6 +5,9 @@ benchmark/paddle/image/*.py, benchmark/paddle/rnn/rnn.py)."""
 from . import lenet
 from . import resnet
 from . import vgg
+from . import alexnet
+from . import googlenet
+from . import smallnet
 from . import text_classification
 from . import seq2seq
 from . import deep_speech2
@@ -15,7 +18,7 @@ from . import label_semantic_roles
 from . import recommender
 
 __all__ = [
-    "lenet", "resnet", "vgg", "text_classification", "seq2seq",
-    "deep_speech2", "ctr_dnn", "word2vec", "fit_a_line",
-    "label_semantic_roles", "recommender",
+    "lenet", "resnet", "vgg", "alexnet", "googlenet", "smallnet",
+    "text_classification", "seq2seq", "deep_speech2", "ctr_dnn",
+    "word2vec", "fit_a_line", "label_semantic_roles", "recommender",
 ]

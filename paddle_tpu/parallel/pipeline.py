@@ -58,6 +58,13 @@ def pipeline(stage_fn, stacked_params, x, mesh, axis_name="pp",
     b = x.shape[0]
     if b % m:
         raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    stage_dims = {p.shape[0] for p in jax.tree.leaves(stacked_params)}
+    if stage_dims != {pp}:
+        raise ValueError(
+            f"stacked stage params have leading dim(s) {sorted(stage_dims)} "
+            f"but mesh axis {axis_name!r} has {pp} devices; stack exactly "
+            f"one stage per device (see stack_stage_params)"
+        )
     mb = b // m
     xm = x.reshape(m, mb, *x.shape[1:])
 
