@@ -39,6 +39,10 @@ CONFIGS = {
                    ref="81.69 img/s bs64 Xeon (ResNet-50)", depth=50),
     "lstm": dict(batch=64, seq_len=100, hid=512, dict_dim=10000, classes=2,
                  ref="184 ms/batch bs64 h512 K40m"),
+    # NEW capability (no reference analog): flash-attention GPT LM;
+    # items/s = sequences/s, so tokens/s = items/s * seq_len.
+    "gpt": dict(batch=8, seq_len=1024, vocab=32000, d_model=512, n_layer=8,
+                n_head=8, ref="n/a (reference predates transformers)"),
 }
 
 
@@ -52,6 +56,11 @@ def _build(name, cfg, dtype):
             outs = models.text_classification.build(
                 dict_dim=cfg["dict_dim"], class_dim=cfg["classes"],
                 hid_dim=cfg["hid"], max_len=cfg["seq_len"])
+        elif name == "gpt":
+            outs = models.transformer.build(
+                vocab_size=cfg["vocab"], n_layer=cfg["n_layer"],
+                n_head=cfg["n_head"], d_model=cfg["d_model"],
+                max_len=cfg["seq_len"], dropout_rate=0.0, dtype=dtype)
         elif name in ("vgg", "resnet"):
             mod = getattr(models, name)
             outs = mod.build(depth=cfg["depth"], class_dim=cfg["classes"],
@@ -68,6 +77,13 @@ def _feed(name, cfg, dtype, rng):
     import jax.numpy as jnp
 
     batch = cfg["batch"]
+    if name == "gpt":
+        toks = rng.integers(0, cfg["vocab"],
+                            size=(batch, cfg["seq_len"])).astype(np.int64)
+        lbls = np.roll(toks, -1, axis=1)
+        lbls[:, -1] = -1
+        return {"tokens": jax.device_put(jnp.asarray(toks)),
+                "labels": jax.device_put(jnp.asarray(lbls))}
     if name == "lstm":
         words = rng.integers(0, cfg["dict_dim"],
                              size=(batch, cfg["seq_len"])).astype(np.int64)
@@ -113,12 +129,13 @@ def main(argv):
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     import jax
 
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:
+        print(f"unknown config(s) {unknown}; have {sorted(CONFIGS)}",
+              file=sys.stderr)
+        return 1
     print(f"# devices: {jax.devices()}", file=sys.stderr)
     for name in names:
-        if name not in CONFIGS:
-            print(f"unknown config {name!r}; have {sorted(CONFIGS)}",
-                  file=sys.stderr)
-            return 1
         row = bench_one(name, steps, warmup, dtype)
         print(json.dumps(row))
     return 0

@@ -44,6 +44,7 @@ __all__ = [
     "matmul",
     "mul",
     "flash_attention",
+    "multi_head_attention",
     "topk",
     "warpctc",
     "ctc_greedy_decoder",
@@ -770,6 +771,56 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
         attrs={"causal": bool(causal),
                "sm_scale": 0.0 if sm_scale is None else float(sm_scale)},
     )
+    return out
+
+
+def multi_head_attention(queries, keys, values, d_model, n_head,
+                         dropout_rate=0.0, causal=False, is_test=False,
+                         param_attr=None, name=None):
+    """Multi-head attention block: QKV projections -> fused flash
+    attention (Pallas TPU kernel) -> output projection.
+
+    The reference composes attention from fc + softmax
+    (``trainer_config_helpers/networks.py simple_attention``); this is the
+    modern multi-head form with the O(t) HBM-traffic kernel.  Inputs are
+    ``[batch, time, dim]``; ``d_model`` must divide by ``n_head``.
+    """
+    if d_model % n_head:
+        raise ValueError(f"d_model {d_model} not divisible by n_head {n_head}")
+    from .tensor import reshape
+    from ..param_attr import ParamAttr
+
+    def _proj_attr(suffix):
+        # each projection needs its OWN parameter: a shared named attr
+        # would silently tie Q/K/V/out weights together (create_parameter
+        # reuses same-named params), so suffix any user-provided name.
+        attr = ParamAttr.to_attr(param_attr)
+        if attr is not None and attr.name is not None:
+            import copy
+
+            attr = copy.copy(attr)
+            attr.name = f"{attr.name}_{suffix}"
+        return attr
+
+    b, tq = queries.shape[0], queries.shape[1]
+    tk = keys.shape[1]
+    dh = d_model // n_head
+    q = fc(queries, d_model, num_flatten_dims=2, param_attr=_proj_attr("q"),
+           name=None if name is None else name + "_q")
+    k = fc(keys, d_model, num_flatten_dims=2, param_attr=_proj_attr("k"),
+           name=None if name is None else name + "_k")
+    v = fc(values, d_model, num_flatten_dims=2, param_attr=_proj_attr("v"),
+           name=None if name is None else name + "_v")
+    qh = reshape(q, [b, tq, n_head, dh])
+    kh = reshape(k, [b, tk, n_head, dh])
+    vh = reshape(v, [b, tk, n_head, dh])
+    ctx = flash_attention(qh, kh, vh, causal=causal,
+                          sm_scale=1.0 / float(dh) ** 0.5)
+    ctx = reshape(ctx, [b, tq, d_model])
+    out = fc(ctx, d_model, num_flatten_dims=2, param_attr=_proj_attr("out"),
+             name=None if name is None else name + "_out")
+    if dropout_rate:
+        out = dropout(out, dropout_rate, is_test=is_test)
     return out
 
 

@@ -16,9 +16,11 @@ from . import word2vec
 from . import fit_a_line
 from . import label_semantic_roles
 from . import recommender
+from . import transformer
 
 __all__ = [
     "lenet", "resnet", "vgg", "alexnet", "googlenet", "smallnet",
     "text_classification", "seq2seq", "deep_speech2", "ctr_dnn",
     "word2vec", "fit_a_line", "label_semantic_roles", "recommender",
+    "transformer",
 ]

@@ -35,7 +35,7 @@ def _aux_head(input, class_dim):
     return layers.fc(input=drop, size=class_dim, act="softmax")
 
 
-def googlenet(input, class_dim=1000):
+def googlenet(input, class_dim=1000, with_aux_heads=True):
     # stem
     conv = layers.conv2d(input, num_filters=64, filter_size=7, stride=2,
                          padding=3, act="relu")
@@ -65,6 +65,8 @@ def googlenet(input, class_dim=1000):
     pool5 = layers.pool2d(ince5b, pool_type="avg", global_pooling=True)
     drop = layers.dropout(pool5, dropout_prob=0.4)
     out = layers.fc(input=drop, size=class_dim, act="softmax")
+    if not with_aux_heads:
+        return out, None, None
     out1 = _aux_head(ince4a, class_dim)
     out2 = _aux_head(ince4d, class_dim)
     return out, out1, out2
@@ -74,7 +76,8 @@ def build(class_dim=1000, image_shape=(3, 224, 224), learning_rate=0.01,
           dtype="bfloat16", with_aux_heads=True):
     img = layers.data("img", shape=list(image_shape), dtype=dtype)
     label = layers.data("label", shape=[1], dtype="int64")
-    prediction, out1, out2 = googlenet(img, class_dim)
+    prediction, out1, out2 = googlenet(img, class_dim,
+                                       with_aux_heads=with_aux_heads)
     pred32 = layers.cast(prediction, "float32")
     cost = layers.mean(layers.cross_entropy(input=pred32, label=label))
     if with_aux_heads:

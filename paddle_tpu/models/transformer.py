@@ -1,0 +1,86 @@
+"""Decoder-only transformer language model — the long-context flagship.
+
+The reference predates transformers (its attention is composed fc+softmax,
+``trainer_config_helpers/networks.py simple_attention``); this model is the
+framework's NEW long-context capability built the TPU way: fused flash
+attention (``ops/pallas_attention.py``), pre-LN residual blocks, bf16
+matmuls on the MXU, remat via ``memory_optimize``, and mesh-ready — batch
+axis shards over ``dp`` (``parallel.data_parallel``), QKV/FFN weights
+column/row-shard over ``tp`` (``parallel.shard_parameters_by_rule``), the
+sequence axis over ``sp`` (``parallel.ring_attention``), experts over
+``ep`` (``parallel.moe``).
+"""
+
+from .. import layers, optimizer as opt
+from ..layers import tensor as ltensor
+
+
+def transformer_block(x, d_model, n_head, d_ff, dropout_rate, is_test,
+                      name):
+    """Pre-LN block: x + MHA(LN(x)) then x + FFN(LN(x))."""
+    ln1 = layers.layer_norm(x, begin_norm_axis=2, name=name + "_ln1")
+    att = layers.multi_head_attention(
+        ln1, ln1, ln1, d_model=d_model, n_head=n_head,
+        dropout_rate=dropout_rate, causal=True, is_test=is_test,
+        name=name + "_att")
+    x = x + att
+    ln2 = layers.layer_norm(x, begin_norm_axis=2, name=name + "_ln2")
+    ff = layers.fc(ln2, d_ff, num_flatten_dims=2, act="gelu",
+                   name=name + "_ffn1")
+    ff = layers.fc(ff, d_model, num_flatten_dims=2, name=name + "_ffn2")
+    if dropout_rate:
+        ff = layers.dropout(ff, dropout_rate, is_test=is_test)
+    return x + ff
+
+
+def gpt(tokens, vocab_size, n_layer=4, n_head=8, d_model=256, d_ff=None,
+        max_len=128, dropout_rate=0.1, is_test=False, dtype="bfloat16"):
+    """Causal LM trunk: returns [batch, time, vocab] logits (float32)."""
+    d_ff = d_ff or 4 * d_model
+    b, t = tokens.shape[0], tokens.shape[1]
+    emb = layers.embedding(tokens, size=[vocab_size, d_model],
+                           param_attr="tok_emb.w")
+    pos = ltensor.create_parameter([t, d_model], dtype="float32",
+                                   name="pos_emb.w")
+    x = emb + pos
+    x = ltensor.cast(x, dtype)
+    if dropout_rate:
+        x = layers.dropout(x, dropout_rate, is_test=is_test)
+    for i in range(n_layer):
+        x = transformer_block(x, d_model, n_head, d_ff, dropout_rate,
+                              is_test, name=f"block{i}")
+    x = layers.layer_norm(x, begin_norm_axis=2, name="ln_f")
+    logits = layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False,
+                       name="lm_head")
+    return ltensor.cast(logits, "float32")
+
+
+def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
+          max_len=128, dropout_rate=0.1, is_test=False,
+          learning_rate=1e-3, dtype="bfloat16"):
+    """Next-token-prediction training program.
+
+    Feeds: tokens [batch, max_len] int64, labels [batch, max_len] int64
+    (tokens shifted left by one, label -1 = padding, masked out of the
+    loss)."""
+    tokens = layers.data("tokens", shape=[max_len], dtype="int64")
+    labels = layers.data("labels", shape=[max_len], dtype="int64")
+    logits = gpt(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
+                 d_model=d_model, d_ff=d_ff, max_len=max_len,
+                 dropout_rate=dropout_rate, is_test=is_test, dtype=dtype)
+    flat_logits = ltensor.reshape(logits, [-1, vocab_size])
+    flat_labels = ltensor.reshape(labels, [-1, 1])
+    mask = ltensor.cast(
+        layers.greater_equal(flat_labels, ltensor.fill_constant(
+            shape=[1], dtype="int64", value=0)), "float32")
+    safe_labels = layers.elementwise_max(
+        flat_labels, ltensor.fill_constant(shape=[1], dtype="int64",
+                                           value=0))
+    loss = layers.softmax_with_cross_entropy(flat_logits, safe_labels)
+    masked = loss * mask
+    avg_cost = layers.reduce_sum(masked) / (
+        layers.reduce_sum(mask) + 1e-8)
+    optimizer = opt.Adam(learning_rate=learning_rate)
+    optimizer.minimize(avg_cost)
+    return {"feed": [tokens, labels], "logits": logits,
+            "avg_cost": avg_cost}

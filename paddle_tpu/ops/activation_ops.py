@@ -36,6 +36,7 @@ _simple("reciprocal", lambda X: 1.0 / X)
 _simple("log", lambda X: jnp.log(X))
 _simple("square", lambda X: jnp.square(X))
 _simple("softplus", lambda X: jax.nn.softplus(X))
+_simple("gelu", lambda X: jax.nn.gelu(X))
 _simple("softsign", lambda X: X / (1 + jnp.abs(X)))
 
 

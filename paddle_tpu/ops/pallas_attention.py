@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 NEG_INF = -1e30
+LSE_LANES = 128  # Mosaic min lane tile; lse vectors are lane-replicated
 
 
 def _pick_block(t, cap):
@@ -74,7 +75,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    # lse is replicated across a 128-lane trailing dim: Mosaic requires the
+    # last two block dims be (8k, 128m) tiles, so a [bq] vector per grid
+    # cell is stored as [bq, 128] (the official TPU flash kernels do the
+    # same); the wrapper slices lane 0 back out.
+    lse_ref[0] = jnp.broadcast_to(
+        (m + jnp.log(l_safe))[:, None], (bq, LSE_LANES)
+    )
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -99,15 +106,15 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t_q, LSE_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    return o, lse[:, :, 0]
 
 
 def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_k):
