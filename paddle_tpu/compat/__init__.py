@@ -1,0 +1,13 @@
+"""Compatibility surfaces for the reference's older APIs.
+
+`v1` — the trainer_config_helpers layer-DSL names (reference:
+python/paddle/trainer_config_helpers/layers.py, 275 defs).  The shim maps
+the commonly used subset onto the paddle_tpu layers DSL so v1-style model
+configs build a Program directly; the v1 proto pipeline (config_parser →
+TrainerConfig proto) is deliberately not reproduced — configuration IS
+the Program here (PARITY.md "Known deliberate divergences").
+"""
+
+from . import v1
+
+__all__ = ["v1"]

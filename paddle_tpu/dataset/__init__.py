@@ -22,9 +22,10 @@ from . import wmt16
 from . import conll05
 from . import flowers
 from . import voc2012
+from . import mq2007
 
 __all__ = [
     "common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
     "sentiment", "movielens", "wmt14", "wmt16", "conll05", "flowers",
-    "voc2012",
+    "voc2012", "mq2007",
 ]

@@ -1,0 +1,104 @@
+"""MQ2007 LETOR learning-to-rank dataset (reference:
+v2/dataset/mq2007.py — TREC Million Query 2007; 46-dim feature vectors
+with graded relevance labels, served in pointwise / pairwise / listwise
+formats).  Offline synthetic surrogate: queries with Gaussian document
+features whose relevance is a noisy linear score, same schema.
+
+Formats:
+  pointwise: (score float, feature [46])
+  pairwise : (label [1], better_feature [46], worse_feature [46])
+  listwise : (scores [n], features [n, 46])
+"""
+
+import os
+
+import numpy as np
+
+from . import common
+
+FEATURE_DIM = 46
+
+
+def _parse_letor(path):
+    """Parse LETOR text: '<rel> qid:<id> 1:<v> 2:<v> ... # docid'."""
+    queries = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rel = float(parts[0])
+            qid = parts[1].split(":")[1]
+            feat = np.zeros(FEATURE_DIM, np.float32)
+            for tok in parts[2:]:
+                idx, val = tok.split(":")
+                i = int(idx) - 1
+                if 0 <= i < FEATURE_DIM:
+                    feat[i] = float(val)
+            queries.setdefault(qid, []).append((rel, feat))
+    return list(queries.values())
+
+
+def _synthetic_queries(n_queries, seed):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(FEATURE_DIM).astype(np.float32)
+    queries = []
+    for _ in range(n_queries):
+        n_docs = rng.randint(5, 20)
+        feats = rng.randn(n_docs, FEATURE_DIM).astype(np.float32)
+        scores = feats @ w + 0.5 * rng.randn(n_docs)
+        # grade into 0/1/2 relevance buckets like LETOR
+        rel = np.digitize(scores, np.quantile(scores, [0.5, 0.85]))
+        queries.append([(float(r), f) for r, f in zip(rel, feats)])
+    return queries
+
+
+def _load(split, seed):
+    path = common.data_path("mq2007", f"{split}.txt")
+    if os.path.exists(path):
+        return _parse_letor(path)
+    return _synthetic_queries(300 if split == "train" else 100, seed)
+
+
+def _pointwise(queries):
+    def reader():
+        for docs in queries:
+            for rel, feat in docs:
+                yield np.float32(rel), feat
+
+    return reader
+
+
+def _pairwise(queries):
+    def reader():
+        for docs in queries:
+            ranked = sorted(docs, key=lambda d: -d[0])
+            for i, (ri, fi) in enumerate(ranked):
+                for rj, fj in ranked[i + 1:]:
+                    if ri > rj:
+                        yield np.asarray([1.0], np.float32), fi, fj
+
+    return reader
+
+
+def _listwise(queries):
+    def reader():
+        for docs in queries:
+            scores = np.asarray([d[0] for d in docs], np.float32)
+            feats = np.stack([d[1] for d in docs])
+            yield scores, feats
+
+    return reader
+
+
+_FORMATS = {"pointwise": _pointwise, "pairwise": _pairwise,
+            "listwise": _listwise}
+
+
+def train(format="pairwise"):
+    return _FORMATS[format](_load("train", 17))
+
+
+def test(format="pairwise"):
+    return _FORMATS[format](_load("test", 18))

@@ -19,8 +19,9 @@ from .decorator import (
     batch,
 )
 from . import creator
+from . import provider
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-    "xmap_readers", "batch", "creator",
+    "xmap_readers", "batch", "creator", "provider",
 ]

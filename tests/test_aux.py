@@ -150,3 +150,56 @@ def test_cli_merge_model(tmp_path):
     r = _run_cli("merge_model", str(model_dir), str(out))
     assert r.returncode == 0, r.stderr
     assert out.exists() and out.stat().st_size > 0
+
+
+def test_mq2007_dataset_formats():
+    from paddle_tpu.dataset import mq2007
+
+    score, feat = next(mq2007.train("pointwise")())
+    assert feat.shape == (46,) and np.isfinite(score)
+    label, better, worse = next(mq2007.train("pairwise")())
+    assert label.shape == (1,) and better.shape == worse.shape == (46,)
+    scores, feats = next(mq2007.test("listwise")())
+    assert feats.shape == (len(scores), 46)
+
+
+def test_provider_decorator_protocol():
+    """PyDataProvider2 @provider shim: typed slots, dict rows, caching."""
+    from paddle_tpu.reader import provider as p
+
+    calls = {"n": 0}
+
+    @p.provider(input_types={"img": p.dense_vector(4),
+                             "label": p.integer_value(10)},
+                cache=p.CacheType.CACHE_PASS_IN_MEM)
+    def process(settings, filename):
+        assert settings.input_types is not None
+        calls["n"] += 1
+        for i in range(5):
+            yield {"label": i, "img": [i] * 4}
+
+    reader = process([None])
+    rows = list(reader())
+    assert len(rows) == 5
+    img, label = rows[2]
+    assert img.dtype == np.float32 and img.shape == (4,)
+    assert label.dtype == np.int64 and int(label) == 2
+    rows2 = list(reader())  # second pass: served from the in-mem cache
+    assert calls["n"] == 1, "generator re-entered despite CACHE_PASS_IN_MEM"
+    assert all((a[0] == b[0]).all() and a[1] == b[1]
+               for a, b in zip(rows, rows2))
+
+
+def test_provider_sparse_and_sequence_slots():
+    from paddle_tpu.reader import provider as p
+
+    @p.provider(input_types=[p.sparse_binary_vector(6),
+                             p.integer_value_sequence(100),
+                             p.sparse_float_vector(5)])
+    def process(settings, filename):
+        yield [1, 3], [7, 8, 9], [(0, 0.5), (4, 2.0)]
+
+    sb, seq, sf = next(process()())
+    assert sb.tolist() == [0, 1, 0, 1, 0, 0]
+    assert seq.tolist() == [7, 8, 9] and seq.dtype == np.int64
+    assert sf.tolist() == [0.5, 0, 0, 0, 2.0]
