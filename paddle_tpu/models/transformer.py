@@ -55,6 +55,127 @@ def gpt(tokens, vocab_size, n_layer=4, n_head=8, d_model=256, d_ff=None,
     return ltensor.cast(logits, "float32")
 
 
+def extract_params(scope=None, program=None):
+    """Pull the model weights (not optimizer state) out of a scope as the
+    name->array dict `generate` consumes."""
+    import numpy as np
+
+    from ..core.program import default_main_program
+    from ..core.scope import global_scope
+
+    scope = scope or global_scope()
+    program = program or default_main_program()
+    # weights are Parameter instances; optimizer accumulators are plain
+    # persistable vars — all_parameters() is exactly the model weights.
+    return {
+        p.name: np.asarray(scope.get(p.name))
+        for p in program.all_parameters()
+        if scope.find_var(p.name) is not None
+    }
+
+
+def generate(params, prompt, max_len, n_layer, n_head, d_model,
+             temperature=0.0, key=None, eps=1e-5):
+    """Jitted autoregressive decoding with a KV cache (pure-JAX serving
+    path over the trained Program parameters — train with the Program,
+    serve with `jax.jit(generate)`-style incremental decode; the analog
+    of the reference's RecurrentGradientMachine.generateSequence,
+    `RecurrentGradientMachine.h:307`, re-designed around lax.scan).
+
+    params   name->array mapping with the Program's parameter names
+             (e.g. ``scope.to_dict()`` or ``io.load_persistables``);
+             works with float32 or bfloat16 weights.
+    prompt   [batch, p_len] int32/int64 prompt tokens (p_len >= 1).
+    max_len  total sequence length to produce (>= p_len).
+    temperature  0.0 = greedy argmax; otherwise softmax sampling
+             (``key`` required).
+
+    Returns ``(tokens, logits)``: tokens [batch, max_len] int32 (prompt
+    prefix included verbatim), logits [batch, max_len, vocab] float32
+    (position t's next-token distribution).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if temperature and key is None:
+        raise ValueError("temperature > 0 sampling requires a PRNG `key`")
+    p = {k: jnp.asarray(v, jnp.float32) for k, v in params.items()}
+    b, p_len = prompt.shape
+    dh = d_model // n_head
+    prompt = jnp.asarray(prompt, jnp.int32)
+    pos_emb = p["pos_emb.w.w"][:max_len]
+
+    def ln(x, name):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        xn = (x - mu) / jnp.sqrt(var + eps)
+        return xn * p[f"{name}.scale"] + p[f"{name}.bias"]
+
+    def step_logits(tok, t, cache):
+        """One token [b] at position t -> (logits [b, vocab], cache')."""
+        x = p["tok_emb.w"][tok] + pos_emb[t]          # [b, d]
+        for i in range(n_layer):
+            h = ln(x, f"block{i}_ln1")
+            q = (h @ p[f"block{i}_att_q.w"] + p[f"block{i}_att_q.b"])
+            k = (h @ p[f"block{i}_att_k.w"] + p[f"block{i}_att_k.b"])
+            v = (h @ p[f"block{i}_att_v.w"] + p[f"block{i}_att_v.b"])
+            qh = q.reshape(b, n_head, dh)
+            kh = k.reshape(b, n_head, dh)
+            vh = v.reshape(b, n_head, dh)
+            ck = jax.lax.dynamic_update_index_in_dim(
+                cache[f"k{i}"], kh, t, axis=1)          # [b, T, h, dh]
+            cv = jax.lax.dynamic_update_index_in_dim(
+                cache[f"v{i}"], vh, t, axis=1)
+            cache = dict(cache, **{f"k{i}": ck, f"v{i}": cv})
+            s = jnp.einsum("bhd,bThd->bhT", qh, ck) / jnp.sqrt(float(dh))
+            mask = jnp.arange(max_len)[None, None, :] <= t
+            s = jnp.where(mask, s, -1e30)
+            a = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhT,bThd->bhd", a, cv).reshape(b, d_model)
+            att = ctx @ p[f"block{i}_att_out.w"] + p[f"block{i}_att_out.b"]
+            x = x + att
+            h2 = ln(x, f"block{i}_ln2")
+            ff = jax.nn.gelu(h2 @ p[f"block{i}_ffn1.w"]
+                             + p[f"block{i}_ffn1.b"])
+            ff = ff @ p[f"block{i}_ffn2.w"] + p[f"block{i}_ffn2.b"]
+            x = x + ff
+        x = ln(x, "ln_f")
+        return x @ p["lm_head.w"], cache
+
+    cache = {}
+    for i in range(n_layer):
+        cache[f"k{i}"] = jnp.zeros((b, max_len, n_head, dh), jnp.float32)
+        cache[f"v{i}"] = jnp.zeros((b, max_len, n_head, dh), jnp.float32)
+
+    def scan_body(carry, t):
+        tokens, cache, key = carry
+        tok = tokens[:, t]
+        logits, cache = step_logits(tok, t, cache)
+        if temperature and key is not None:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        # positions < p_len keep the prompt; after that, append samples;
+        # the final step (t+1 == max_len) writes nothing (identity write
+        # at the clamped index keeps the last token intact).
+        write_to = jnp.minimum(t + 1, max_len - 1)
+        cur = tokens[:, write_to]
+        writable = ((t + 1) >= p_len) & ((t + 1) < max_len)
+        new = jnp.where(writable, nxt.astype(jnp.int32), cur)
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, new, write_to, axis=1)
+        return (tokens, cache, key), logits
+
+    tokens0 = jnp.zeros((b, max_len), jnp.int32)
+    tokens0 = jax.lax.dynamic_update_slice(tokens0, prompt, (0, 0))
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    (tokens, _, _), logits = jax.lax.scan(
+        scan_body, (tokens0, cache, key), jnp.arange(max_len))
+    return tokens, jnp.swapaxes(logits, 0, 1)  # [b, T] , [b, T, vocab]
+
+
 def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
           max_len=128, dropout_rate=0.1, is_test=False,
           learning_rate=1e-3, dtype="bfloat16"):

@@ -89,3 +89,52 @@ def test_multi_head_attention_layer_shapes_and_grad():
     (yv, cv) = exe.run(feed={"x": xv}, fetch_list=[y, cost])
     assert yv.shape == (2, 6, outs_dim)
     assert np.isfinite(cv).all()
+
+
+def test_generate_matches_program_forward():
+    """KV-cache incremental decode reproduces the Program forward logits
+    on the prompt prefix (same weights, same math, different schedule —
+    the test_NetworkCompare pattern, SURVEY section 4)."""
+    vocab, nl, nh, dm, T = 40, 2, 2, 32, 12
+    outs = transformer.build(vocab_size=vocab, n_layer=nl, n_head=nh,
+                             d_model=dm, max_len=T, dropout_rate=0.0,
+                             is_test=True, dtype="float32")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, vocab, (2, T)).astype(np.int64)
+    lbls = np.roll(toks, -1, axis=1)
+    # snapshot weights BEFORE the train step (the program updates them)
+    params = transformer.extract_params()
+    (prog_logits,) = exe.run(feed={"tokens": toks, "labels": lbls},
+                             fetch_list=[outs["logits"]])
+    gen_tokens, gen_logits = transformer.generate(
+        params, toks, max_len=T, n_layer=nl, n_head=nh, d_model=dm)
+    np.testing.assert_allclose(np.asarray(gen_logits), prog_logits,
+                               rtol=2e-3, atol=2e-3)
+    # full-length prompt comes back verbatim (no last-token overwrite)
+    np.testing.assert_array_equal(np.asarray(gen_tokens), toks)
+
+
+def test_generate_greedy_continuation():
+    """After training next-token = (tok+1) mod vocab, greedy decode
+    continues the pattern from a short prompt."""
+    vocab, nl, nh, dm, T = 16, 1, 2, 32, 8
+    outs = transformer.build(vocab_size=vocab, n_layer=nl, n_head=nh,
+                             d_model=dm, max_len=T, dropout_rate=0.0,
+                             learning_rate=5e-3, dtype="float32")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(6)
+    for _ in range(150):
+        toks = rng.integers(0, vocab, (8, T)).astype(np.int64)
+        lbls = (toks + 1) % vocab
+        exe.run(feed={"tokens": toks, "labels": lbls},
+                fetch_list=[outs["avg_cost"]])
+    params = transformer.extract_params()
+    prompt = np.asarray([[3, 4], [10, 11]], np.int64)
+    tokens, _ = transformer.generate(params, prompt, max_len=T,
+                                     n_layer=nl, n_head=nh, d_model=dm)
+    tokens = np.asarray(tokens)
+    expect = (prompt[:, -1:] + np.arange(1, T - 1)) % vocab
+    assert (tokens[:, 2:] == expect).mean() > 0.7, tokens
