@@ -58,6 +58,20 @@ class LoweringCtx:
         run_block_ops(self, blk, blk.ops, env)
 
 
+def _check_fetches(program, fetch_names):
+    """Fail fast with a useful message when a fetch var is not in the
+    program — usually the default program is not the one the model was
+    built in (missing program= argument / program_guard)."""
+    known = {n for blk in program.blocks for n in blk.vars}
+    missing = [n for n in fetch_names if n not in known]
+    if missing:
+        raise ValueError(
+            f"fetch var(s) {missing} not found in the program "
+            f"({len(program.global_block().ops)} ops); pass the program "
+            f"the model was built in (program= argument or program_guard)"
+        )
+
+
 def _gather_input(env, block, name, inside_grad_prefix):
     val = env[name]
     if inside_grad_prefix:
@@ -120,14 +134,10 @@ class Executor:
         self._cache = {}
 
     # ------------------------------------------------------------------
-    def run(
-        self,
-        program=None,
-        feed=None,
-        fetch_list=None,
-        scope=None,
-        return_numpy=True,
-    ):
+    def _prepare(self, program, feed, fetch_list, scope):
+        """Shared run()/run_steps() prologue: resolve defaults, coerce
+        feeds (device arrays stay on device), snapshot state, build the
+        compile-cache signature."""
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -138,7 +148,6 @@ class Executor:
         fetch_names = [
             v.name if hasattr(v, "name") else str(v) for v in fetch_list
         ]
-
         block = program.global_block()
         feed_vals = []
         for n in feed_names:
@@ -167,19 +176,11 @@ class Executor:
         feed_sig = tuple(
             (n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_vals)
         )
-        key = (
-            program._serial,
-            program._version,
-            feed_sig,
-            tuple(fetch_names),
-            state_names,
-        )
-        step = self._cache.get(key)
-        if step is None:
-            step = self._compile(program, feed_names, fetch_names, state_names)
-            self._cache[key] = step
+        return (program, scope, feed_names, fetch_names, feed_vals,
+                state_names, state, feed_sig)
 
-        new_state, fetches = step(state, *feed_vals)
+    def _finish(self, scope, new_state, fetch_names, fetches, return_numpy):
+        """Shared run()/run_steps() postlude: debug flags, scope update."""
         from ..flags import FLAGS
 
         if FLAGS.check_nan_inf:
@@ -203,6 +204,133 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        scope=None,
+        return_numpy=True,
+    ):
+        (program, scope, feed_names, fetch_names, feed_vals, state_names,
+         state, feed_sig) = self._prepare(program, feed, fetch_list, scope)
+        key = (
+            program._serial,
+            program._version,
+            feed_sig,
+            tuple(fetch_names),
+            state_names,
+        )
+        step = self._cache.get(key)
+        if step is None:
+            _check_fetches(program, fetch_names)
+            step = self._compile(program, feed_names, fetch_names, state_names)
+            self._cache[key] = step
+
+        new_state, fetches = step(state, *feed_vals)
+        return self._finish(scope, new_state, fetch_names, fetches,
+                            return_numpy)
+
+    # ------------------------------------------------------------------
+    def run_steps(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        steps=None,
+        scope=None,
+        return_numpy=True,
+    ):
+        """Run ``steps`` training steps as ONE jitted ``lax.scan`` — the
+        whole inner loop compiles to a single XLA computation, so per-step
+        host dispatch (the cost the reference pays per *op* in its
+        interpreter loop, executor.cc:118) disappears entirely.
+
+        ``feed`` values are STACKED along a leading steps axis
+        ([steps, batch, ...]); ``steps`` defaults to that axis.  Fetches
+        come back stacked ([steps, ...]).  State (parameters, RNG) carries
+        through the scan exactly as across separate ``run`` calls.
+        """
+        (program, scope, feed_names, fetch_names, feed_vals, state_names,
+         state, feed_sig) = self._prepare(program, feed, fetch_list, scope)
+        if steps is None:
+            if not feed_vals:
+                raise ValueError("steps is required when there is no feed")
+            steps = int(feed_vals[0].shape[0])
+        for n, v in zip(feed_names, feed_vals):
+            if v.shape[0] != steps:
+                raise ValueError(
+                    f"feed {n!r} leading (steps) axis {v.shape[0]} != "
+                    f"{steps}; run_steps feeds are stacked [steps, ...]"
+                )
+
+        key = (
+            "scan",
+            steps,
+            program._serial,
+            program._version,
+            feed_sig,
+            tuple(fetch_names),
+            state_names,
+        )
+        fn = self._cache.get(key)
+        if fn is None:
+            _check_fetches(program, fetch_names)
+            fn = self._compile_scan(
+                program, feed_names, fetch_names, state_names, steps
+            )
+            self._cache[key] = fn
+
+        new_state, fetches = fn(state, *feed_vals)
+        return self._finish(scope, new_state, fetch_names, fetches,
+                            return_numpy)
+
+    def _compile_scan(self, program, feed_names, fetch_names, state_names,
+                      steps):
+        step, persist_out = self.lower(
+            program, feed_names, fetch_names, state_names)
+        # lax.scan requires carry-in == carry-out structure: every
+        # persistable the step will emit must already be in the scope
+        # (run() tolerates the step creating them; a scan cannot).
+        extra = sorted(set(persist_out) - set(state_names))
+        if extra:
+            raise ValueError(
+                f"run_steps needs persistable var(s) {extra} initialized "
+                f"before the scan (run the startup program, or one "
+                f"regular run() step, first)"
+            )
+
+        def multi(state, *stacked_feeds):
+            def body(s, fs):
+                return step(s, *fs)
+
+            xs = tuple(stacked_feeds) if stacked_feeds else None
+            new_state, fetches = jax.lax.scan(
+                body, state, xs, length=steps)
+            return new_state, fetches
+
+        jit_kwargs = {}
+        if self.donate_state:
+            jit_kwargs["donate_argnums"] = 0
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel.api import compile_shardings
+
+            in_sh, out_sh = compile_shardings(
+                self.mesh, program, feed_names, fetch_names, state_names,
+                out_state_names=persist_out,
+            )
+            state_sh, *feed_sh = in_sh
+            # stacked feeds get an unsharded leading steps axis
+            feed_sh = [
+                NamedSharding(self.mesh, PartitionSpec(None, *s.spec))
+                for s in feed_sh
+            ]
+            jit_kwargs["in_shardings"] = (state_sh, *feed_sh)
+            jit_kwargs["out_shardings"] = out_sh
+        return jax.jit(multi, **jit_kwargs)
 
     # ------------------------------------------------------------------
     def lower(self, program, feed_names, fetch_names, state_names):

@@ -103,6 +103,13 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
     b, p_len = prompt.shape
     dh = d_model // n_head
     prompt = jnp.asarray(prompt, jnp.int32)
+    table_len = p["pos_emb.w.w"].shape[0]
+    if max_len > table_len:
+        # XLA clamps out-of-range gathers, which would silently reuse the
+        # last position embedding past the trained length — fail instead.
+        raise ValueError(
+            f"max_len {max_len} exceeds the trained position-embedding "
+            f"table ({table_len} positions)")
     pos_emb = p["pos_emb.w.w"][:max_len]
 
     def ln(x, name):
