@@ -25,27 +25,36 @@ def _is_persistable(var):
     return var.persistable
 
 
+def _write_snapshot(dirname, snap):
+    """Write a {name: ndarray} snapshot as one .npy per tensor + CRC
+    manifest — THE on-disk checkpoint format (shared by save_vars and
+    AsyncCheckpointer so the two writers cannot drift)."""
+    os.makedirs(dirname, exist_ok=True)
+    manifest = {}
+    for name, arr in snap.items():
+        fname = name.replace("/", "__")
+        path = os.path.join(dirname, fname)
+        np.save(path + ".npy", arr)
+        with open(path + ".npy", "rb") as f:
+            crc = zlib.crc32(f.read())
+        manifest[name] = {"file": fname + ".npy", "crc32": crc,
+                          "shape": list(arr.shape), "dtype": str(arr.dtype)}
+    with open(os.path.join(dirname, "__manifest__.pkl"), "wb") as f:
+        pickle.dump(manifest, f)
+
+
 def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
     program = main_program or default_main_program()
     scope = global_scope()
     if vars is None:
         vars = [v for v in program.global_block().vars.values() if predicate(v)]
-    os.makedirs(dirname, exist_ok=True)
-    manifest = {}
+    snap = {}
     for var in vars:
         val = scope.find_var(var.name)
         if val is None:
             continue
-        arr = np.asarray(val)
-        fname = var.name.replace("/", "__")
-        path = os.path.join(dirname, fname)
-        np.save(path + ".npy", arr)
-        with open(path + ".npy", "rb") as f:
-            crc = zlib.crc32(f.read())
-        manifest[var.name] = {"file": fname + ".npy", "crc32": crc,
-                              "shape": list(arr.shape), "dtype": str(arr.dtype)}
-    with open(os.path.join(dirname, "__manifest__.pkl"), "wb") as f:
-        pickle.dump(manifest, f)
+        snap[var.name] = np.asarray(val)
+    _write_snapshot(dirname, snap)
 
 
 def save_params(executor, dirname, main_program=None):
@@ -126,3 +135,99 @@ def load_inference_model(dirname, executor):
 def get_inference_program(target_vars, main_program=None):
     program = main_program or default_main_program()
     return program.clone(for_test=True).prune(target_vars)
+
+
+class AsyncCheckpointer:
+    """Background-thread checkpointing (the TPU-era upgrade of the
+    reference's synchronous per-pass save, trainer/ParamUtil.cpp and the
+    Go pserver's periodic checkpoint, go/pserver/service.go:342).
+
+    ``save()`` snapshots the persistable state to host numpy synchronously
+    (cheap: one device->host copy; the arrays are immutable so this is the
+    only point that must block training) and hands serialization + disk IO
+    + CRC to a worker thread.  Files match ``save_persistables`` exactly,
+    so ``load_persistables`` restores them.
+
+        ckpt = io.AsyncCheckpointer()
+        for pass_id in range(passes):
+            train_one_pass()
+            ckpt.save(f"ckpt/pass_{pass_id}")   # returns immediately
+        ckpt.close()                             # drain pending writes
+    """
+
+    def __init__(self, max_pending=2):
+        import queue
+        import threading
+
+        self._q = queue.Queue(maxsize=max_pending)
+        self._errors = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            dirname, snap = item
+            try:
+                self._write(dirname, snap)
+            except Exception as e:  # surfaced on next save()/close()
+                self._errors.append(e)
+            finally:
+                self._q.task_done()
+
+    @staticmethod
+    def _write(dirname, snap):
+        import shutil
+
+        tmp = dirname + ".tmp"
+        if os.path.exists(tmp):  # leftovers from a crashed prior run
+            shutil.rmtree(tmp)
+        _write_snapshot(tmp, snap)
+        # publish without a no-checkpoint window: move any existing
+        # checkpoint aside first, then rename tmp into place; only after
+        # the new one is live is the old one removed.
+        old = dirname + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        if os.path.exists(dirname):
+            os.replace(dirname, old)
+        os.replace(tmp, dirname)
+        if os.path.exists(old):
+            shutil.rmtree(old)
+
+    def _raise_pending(self):
+        if self._errors:
+            err, self._errors = self._errors, []  # atomic swap, no lost errors
+            raise RuntimeError(f"async checkpoint write(s) failed: {err}")
+
+    def save(self, dirname, main_program=None, scope=None):
+        """Snapshot now, write in the background.  Blocks only if
+        ``max_pending`` earlier checkpoints are still being written."""
+        self._raise_pending()
+        program = main_program or default_main_program()
+        scope = scope or global_scope()
+        snap = {}
+        for var in program.global_block().vars.values():
+            if not var.persistable:
+                continue
+            val = scope.find_var(var.name)
+            if val is None:
+                continue
+            snap[var.name] = np.asarray(val)
+        self._q.put((dirname, snap))
+
+    def wait(self):
+        """Block until all queued checkpoints are on disk."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self):
+        try:
+            self.wait()
+        finally:
+            # always shut the worker down, even when wait() raises
+            self._q.put(None)
+            self._thread.join()

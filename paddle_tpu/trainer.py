@@ -67,29 +67,45 @@ class Trainer:
         self._initialized = True
 
     def train(self, reader, num_passes=1, event_handler=None,
-              checkpoint_dir=None, checkpoint_every_n_passes=1):
+              checkpoint_dir=None, checkpoint_every_n_passes=1,
+              async_checkpoint=False):
+        """``async_checkpoint=True`` writes per-pass checkpoints from a
+        background thread (io.AsyncCheckpointer): training only pays the
+        device->host snapshot, not serialization + disk IO.  Pending
+        writes are drained before train() returns."""
         if not self._initialized:
             self.init_params()
         event_handler = event_handler or (lambda e: None)
         fetch = [self.cost] + list(self.extra_fetch)
-        for pass_id in range(num_passes):
-            event_handler(BeginPass(pass_id))
-            for batch_id, batch in enumerate(reader()):
-                event_handler(BeginIteration(pass_id, batch_id))
-                with _profiler.timer("train_batch"):
-                    vals = self.exe.run(
-                        self.main_program,
-                        feed=self.feeder.feed(batch),
-                        fetch_list=fetch,
-                    )
-                cost = float(np.asarray(vals[0]).reshape(-1)[0])
-                metrics = [np.asarray(v) for v in vals[1:]]
-                event_handler(EndIteration(pass_id, batch_id, cost, metrics))
-            if checkpoint_dir and (pass_id + 1) % checkpoint_every_n_passes == 0:
-                _io.save_persistables(
-                    self.exe, f"{checkpoint_dir}/pass_{pass_id}", self.main_program
-                )
-            event_handler(EndPass(pass_id))
+        ckpt = _io.AsyncCheckpointer() if (
+            checkpoint_dir and async_checkpoint) else None
+        try:
+            for pass_id in range(num_passes):
+                event_handler(BeginPass(pass_id))
+                for batch_id, batch in enumerate(reader()):
+                    event_handler(BeginIteration(pass_id, batch_id))
+                    with _profiler.timer("train_batch"):
+                        vals = self.exe.run(
+                            self.main_program,
+                            feed=self.feeder.feed(batch),
+                            fetch_list=fetch,
+                        )
+                    cost = float(np.asarray(vals[0]).reshape(-1)[0])
+                    metrics = [np.asarray(v) for v in vals[1:]]
+                    event_handler(EndIteration(pass_id, batch_id, cost,
+                                               metrics))
+                if checkpoint_dir and (
+                        pass_id + 1) % checkpoint_every_n_passes == 0:
+                    path = f"{checkpoint_dir}/pass_{pass_id}"
+                    if ckpt is not None:
+                        ckpt.save(path, self.main_program)
+                    else:
+                        _io.save_persistables(self.exe, path,
+                                              self.main_program)
+                event_handler(EndPass(pass_id))
+        finally:
+            if ckpt is not None:
+                ckpt.close()
 
     def test(self, reader, test_program=None, fetch_list=None):
         """Average fetched values over a test reader (reference
