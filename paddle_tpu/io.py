@@ -73,6 +73,11 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
     scope = global_scope()
     if vars is None:
         vars = [v for v in program.global_block().vars.values() if predicate(v)]
+    if not os.path.exists(os.path.join(dirname, "__manifest__.pkl")) and \
+            os.path.exists(os.path.join(dirname + ".old", "__manifest__.pkl")):
+        # a crash between AsyncCheckpointer's two publish renames leaves
+        # the last good checkpoint at <dirname>.old — recover it
+        dirname = dirname + ".old"
     with open(os.path.join(dirname, "__manifest__.pkl"), "rb") as f:
         manifest = pickle.load(f)
     for var in vars:
@@ -185,11 +190,15 @@ class AsyncCheckpointer:
         tmp = dirname + ".tmp"
         if os.path.exists(tmp):  # leftovers from a crashed prior run
             shutil.rmtree(tmp)
-        _write_snapshot(tmp, snap)
-        # publish without a no-checkpoint window: move any existing
-        # checkpoint aside first, then rename tmp into place; only after
-        # the new one is live is the old one removed.
         old = dirname + ".old"
+        if os.path.exists(old) and not os.path.exists(dirname):
+            # crashed between the two publish renames last run: the .old
+            # copy is the only good checkpoint — restore it first
+            os.replace(old, dirname)
+        _write_snapshot(tmp, snap)
+        # crash-safe publish: some valid checkpoint is always reachable —
+        # dirname, or (between the two renames) dirname + ".old", which
+        # load_vars falls back to.
         if os.path.exists(old):
             shutil.rmtree(old)
         if os.path.exists(dirname):

@@ -49,6 +49,9 @@ __all__ = [
     "warpctc",
     "ctc_greedy_decoder",
     "edit_distance",
+    "l1_norm",
+    "prelu",
+    "bilinear_tensor_product",
     "l2_normalize",
     "im2sequence",
     "nce",
@@ -953,6 +956,57 @@ def edit_distance(input, label, normalized=False, ignored_tokens=None):
         attrs={"normalized": normalized},
     )
     return out, seq_num
+
+
+def l1_norm(x, name=None):
+    helper = LayerHelper("l1_norm", name=name)
+    out = helper.create_tmp_variable(x.dtype, [1])
+    helper.append_op(type="l1_norm", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def prelu(x, param_attr=None, name=None):
+    """Parametric ReLU with a learnable scalar alpha (reference
+    prelu_op.cc)."""
+    helper = LayerHelper("prelu", name=name)
+    alpha = helper.create_parameter(
+        param_attr, shape=[1], dtype=x.dtype, suffix="alpha",
+        default_initializer=init_mod.Constant(0.25),
+    )
+    out = helper.create_tmp_variable(x.dtype, list(x.shape))
+    helper.append_op(
+        type="prelu",
+        inputs={"X": [x.name], "Alpha": [alpha.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
+                            act=None, name=None):
+    """out[b,i] = x[b] @ W[i] @ y[b] + bias[i] (reference
+    bilinear_tensor_product_op.h:30)."""
+    helper = LayerHelper("bilinear_tensor_product", bias_attr=bias_attr,
+                         act=act, name=name)
+    w = helper.create_parameter(
+        param_attr, shape=[size, x.shape[-1], y.shape[-1]], dtype=x.dtype,
+    )
+    out = helper.create_tmp_variable(x.dtype, [x.shape[0], size])
+    inputs = {"X": [x.name], "Y": [y.name], "Weight": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[size],
+            dtype=x.dtype, suffix="b",
+            default_initializer=init_mod.Constant(0.0),
+        )
+        inputs["Bias"] = [b.name]
+    helper.append_op(
+        type="bilinear_tensor_product",
+        inputs=inputs,
+        outputs={"Out": [out.name]},
+    )
+    return helper.append_activation(out)
 
 
 def l2_normalize(x, axis, epsilon=1e-12, name=None):

@@ -40,6 +40,18 @@ _simple("gelu", lambda X: jax.nn.gelu(X))
 _simple("softsign", lambda X: X / (1 + jnp.abs(X)))
 
 
+@register_op("prelu")
+def prelu(X, Alpha, **_):
+    # reference prelu_op.cc:46: f(x) = alpha*x for x<0 else x; Alpha is a
+    # learnable scalar (the reference op takes exactly one alpha; a
+    # channel-wise variant would need explicit axis alignment, so reject
+    # silently-misbroadcast shapes).
+    if Alpha.size != 1:
+        raise ValueError(
+            f"prelu Alpha must be a single scalar, got shape {Alpha.shape}")
+    return {"Out": jnp.where(X >= 0, X, Alpha.reshape(()) * X)}
+
+
 @register_op("brelu")
 def brelu(X, t_min=0.0, t_max=24.0, **_):
     return {"Out": jnp.clip(X, t_min, t_max)}

@@ -157,6 +157,23 @@ def squared_l2_norm(X, **_):
     return {"Out": jnp.sum(jnp.square(X)).reshape(1)}
 
 
+@register_op("l1_norm")
+def l1_norm(X, **_):
+    # reference l1_norm_op.h: Out = sum(|X|)
+    return {"Out": jnp.sum(jnp.abs(X)).reshape(1)}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(X, Y, Weight, Bias=None, **_):
+    # reference bilinear_tensor_product_op.h:30: out[b,i] =
+    # x[b,:] @ W[i,:,:] @ y[b,:] (+ bias[i]); one einsum on the MXU
+    # replaces the per-output-channel gemm loop.
+    out = jnp.einsum("bj,ijk,bk->bi", X, Weight.astype(X.dtype), Y)
+    if Bias is not None:
+        out = out + Bias.astype(X.dtype)
+    return {"Out": out}
+
+
 @register_op("squared_l2_distance")
 def squared_l2_distance(X, Y, **_):
     d = X - _broadcast_y(X, Y, -1)

@@ -1,0 +1,86 @@
+"""Op-inventory parity audit: every reference operator file
+(/root/reference/paddle/operators/*_op.cc, SURVEY §2.2, ~143 ops) must map
+to a registered op, a named alias, or a documented deliberate divergence.
+A reference op missing from all three fails the test — silent gaps can't
+creep in as the registry evolves."""
+
+from paddle_tpu.core.registry import registered_ops
+
+# reference umbrella files -> the registered ops that carry them
+ALIASES = {
+    "activation": ["sigmoid", "relu", "tanh", "exp", "abs", "softplus"],
+    "compare": ["less_than", "less_equal", "greater_than", "greater_equal",
+                "equal", "not_equal"],
+    "logical": ["logical_and", "logical_or", "logical_not", "logical_xor"],
+    "conv": ["conv2d", "conv3d", "depthwise_conv2d"],
+    "conv_transpose": ["conv2d_transpose"],
+    "pool": ["pool2d"],
+    "pool_with_index": ["max_pool2d_with_index"],
+    "reduce": ["reduce_sum", "reduce_mean", "reduce_max", "reduce_min"],
+    "fill": ["fill_constant"],
+    "cond": ["conditional_block"],
+    "recurrent": ["scan_block"],  # scan-based dynamic RNN engine
+    "lookup_table": ["lookup_table"],
+    "tensor_array_read_write": ["array_read", "array_write"],
+    "lod_array_length": ["array_length"],
+    "top_k": ["top_k"],
+    "smooth_l1_loss": ["smooth_l1_loss"],
+    "softmax_with_cross_entropy": ["softmax_with_cross_entropy"],
+    "get_places": [],  # layers.device.get_places (mesh devices)
+}
+
+# capabilities carried by a different mechanism than an op — each entry
+# names the carrier (see PARITY.md for the full rationale)
+DIVERGENT = {
+    "nccl": "jax.lax collectives inserted by GSPMD (parallel/api.py)",
+    "send": "distributed/rpc.py + pserver client",
+    "recv": "distributed/pserver.py server-side optimizer",
+    "net": "Program IS the net; no grouping op needed",
+    "rnn_memory_helper": "lax.scan carries step state (ops/rnn_ops.py)",
+    "shrink_rnn_memory": "static shapes + length masking",
+    "max_sequence_len": "@LENGTH vectors carry lengths",
+    "lod_rank_table": "bucketing readers sort by length",
+    "reorder_lod_tensor_by_rank": "bucketing readers",
+    "lod_tensor_to_array": "lax.scan over padded time axis",
+    "array_to_lod_tensor": "lax.scan stacked outputs",
+    "split_lod_tensor": "batch-axis sharding (data_parallel)",
+    "merge_lod_tensor": "batch-axis sharding (data_parallel)",
+    "lod_reset": "@LENGTH vectors are plain tensors; assign replaces them",
+    "split_selected_rows": "parallel/sparse.py rows+values wire format",
+}
+
+
+def _reference_ops():
+    import glob
+    import os
+
+    files = glob.glob("/root/reference/paddle/operators/*_op.cc")
+    return sorted(os.path.basename(f)[: -len("_op.cc")] for f in files)
+
+
+def test_every_reference_op_is_carried():
+    ref = _reference_ops()
+    if not ref:  # reference tree not present (CI elsewhere) — skip
+        import pytest
+
+        pytest.skip("reference tree unavailable")
+    ours = set(registered_ops())
+    missing = []
+    for name in ref:
+        if name in ours or name in DIVERGENT:
+            continue
+        alias = ALIASES.get(name)
+        if alias is not None:
+            lost = [a for a in alias if a not in ours]
+            if lost:
+                missing.append(f"{name} (alias {lost} unregistered)")
+            continue
+        missing.append(name)
+    assert not missing, (
+        f"reference ops with no registered carrier, alias, or documented "
+        f"divergence: {missing}"
+    )
+
+
+def test_registry_is_larger_than_reference():
+    assert len(registered_ops()) >= 150

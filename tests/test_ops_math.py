@@ -126,3 +126,33 @@ def test_softmax_and_grad():
     check_output("softmax", {"X": x}, {"Out": e / e.sum(-1, keepdims=True)}, atol=1e-5)
     check_grad("softmax", {"X": x}, "X",
                loss_weights=rng.rand(4, 7).astype(np.float32))
+
+
+def test_l1_norm():
+    x = rng.randn(3, 4).astype(np.float32)
+    check_output("l1_norm", {"X": x}, {"Out": np.abs(x).sum().reshape(1)})
+    check_grad("l1_norm", {"X": x + np.sign(x) * 0.1}, "X")
+
+
+def test_bilinear_tensor_product():
+    b, dx, dy, size = 3, 4, 5, 2
+    x = rng.randn(b, dx).astype(np.float32)
+    y = rng.randn(b, dy).astype(np.float32)
+    w = rng.randn(size, dx, dy).astype(np.float32)
+    bias = rng.randn(size).astype(np.float32)
+    want = np.einsum("bj,ijk,bk->bi", x, w, y) + bias
+    check_output("bilinear_tensor_product",
+                 {"X": x, "Y": y, "Weight": w, "Bias": bias},
+                 {"Out": want}, atol=1e-4, rtol=1e-4)
+    check_grad("bilinear_tensor_product",
+               {"X": x, "Y": y, "Weight": w, "Bias": bias}, "Weight")
+    check_grad("bilinear_tensor_product",
+               {"X": x, "Y": y, "Weight": w, "Bias": bias}, "X")
+
+
+def test_prelu():
+    x = rng.randn(3, 4).astype(np.float32)
+    a = np.asarray([0.25], np.float32)
+    check_output("prelu", {"X": x, "Alpha": a},
+                 {"Out": np.where(x >= 0, x, 0.25 * x)})
+    check_grad("prelu", {"X": x + np.sign(x) * 0.1, "Alpha": a}, "Alpha")
