@@ -19,11 +19,12 @@ structural stand-in for the reference's gRPC / Go net/rpc / LightNetwork.
 
 from . import rpc
 from . import store
+from . import launch
 from .master import MasterService, MasterClient
 from .pserver import ParameterServer, PServerClient
 from .transpiler import DistributeTranspiler
 
 __all__ = [
-    "rpc", "store", "MasterService", "MasterClient", "ParameterServer",
-    "PServerClient", "DistributeTranspiler",
+    "rpc", "store", "launch", "MasterService", "MasterClient",
+    "ParameterServer", "PServerClient", "DistributeTranspiler",
 ]

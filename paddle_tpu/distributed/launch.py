@@ -1,0 +1,95 @@
+"""Multi-host launch (reference analogs: the MPI/fabric cluster launchers
+`paddle/scripts/cluster_train_v2/*` and the trainer flags
+`--trainer_id --num_gradient_servers`, utils/Flags.h:21-28).
+
+On TPU pods, multi-host SPMD needs exactly one thing the launchers used
+to provide: every host joins the same JAX coordination service, then the
+SAME single-program code runs on each host over the global mesh
+(`jax.devices()` spans all hosts after init).  The dense path needs no
+pserver — see docs/design/distributed.md.
+
+    # on every host (torchrun/xpk/GKE-style: one process per host)
+    from paddle_tpu.distributed import launch
+    launch.init_multihost(coordinator="host0:1234",
+                          num_processes=N, process_id=i)
+    mesh = launch.global_mesh({"dp": 4, "tp": jax.device_count() // 4})
+    ...  # identical training script on all hosts
+
+Environment fallback: with TPU pod metadata (or `JAX_COORDINATOR_ADDRESS`
+/ `JAX_NUM_PROCESSES` / `JAX_PROCESS_ID` set by the cluster launcher),
+``init_multihost()`` with no arguments autodetects everything.
+"""
+
+import os
+
+__all__ = ["init_multihost", "global_mesh", "is_initialized"]
+
+_initialized = False
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_multihost(coordinator=None, num_processes=None, process_id=None,
+                   local_device_ids=None):
+    """Join (or start, on process 0) the JAX coordination service.
+
+    All arguments optional: on TPU pods and under cluster launchers that
+    set the standard env vars, autodetection does the right thing.
+    Single-process calls are a no-op success so the same script runs
+    unmodified on one host."""
+    global _initialized
+    import jax
+
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+
+    if coordinator is None and num_processes in (None, 1):
+        if _looks_like_pod():
+            # cloud TPU pod: jax autodetects everything from metadata.
+            # Too-late calls (XLA backend already up) and single-chip
+            # environments that merely carry TPU env markers degrade to
+            # single-host with a warning rather than failing.
+            try:
+                jax.distributed.initialize()
+            except RuntimeError as e:
+                import warnings
+
+                warnings.warn(
+                    f"multi-host autodetection unavailable ({e}); "
+                    f"continuing single-host")
+        # else: single host — nothing to coordinate
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def _looks_like_pod():
+    """Multi-host TPU environment markers set by cloud launchers."""
+    return any(os.environ.get(k) for k in (
+        "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID",
+        "MEGASCALE_COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID",
+    ))
+
+
+def global_mesh(axes, devices=None):
+    """Mesh over ALL devices across hosts (jax.devices() is global after
+    init_multihost).  ``axes`` maps axis name -> size; one size may be -1
+    to absorb the remaining device count (validated by make_mesh)."""
+    from ..parallel.mesh import make_mesh
+
+    return make_mesh(axes, devices=devices)

@@ -19,8 +19,13 @@ def make_mesh(axes, devices=None):
     names = list(axes.keys())
     sizes = list(axes.values())
     n = len(devices)
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
     if -1 in sizes:
         known = int(np.prod([s for s in sizes if s != -1]))
+        if known == 0 or n % known:
+            raise ValueError(
+                f"{n} devices not divisible by known axes {known}")
         sizes[sizes.index(-1)] = n // known
     total = int(np.prod(sizes))
     if total != n:

@@ -8,6 +8,7 @@ import pickle
 import threading
 import time
 
+import jax
 import numpy as np
 import pytest
 
@@ -324,3 +325,46 @@ def test_assign_server_stable():
     assert assign_server("w", 4) == assign_server("w", 4)
     spread = {assign_server(f"p{i}", 4) for i in range(32)}
     assert len(spread) == 4
+
+
+def test_transpiler_conv_model_dist():
+    """recognize_digits_conv via the pserver path (reference
+    book_distribute/notest_recognize_digits_conv_dist.py): a real conv
+    model's params sharded over 2 in-process pservers, server-side SGD."""
+    from paddle_tpu.models import lenet
+
+    outs = lenet.build(learning_rate=0.003)
+    main = pt.default_main_program()
+
+    t = DistributeTranspiler()
+    t.transpile(main, pservers=2, trainers=1)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    servers = [ParameterServer(index=i, num_trainers=1) for i in range(2)]
+    dt = DistributedTrainer(t, exe, servers, learning_rate=0.003)
+    dt.init_params_on_pservers()
+
+    rng = np.random.default_rng(3)
+    img = rng.normal(size=(8, 1, 28, 28)).astype(np.float32)
+    lbl = rng.integers(0, 10, (8, 1)).astype(np.int64)
+    losses = []
+    for _ in range(6):
+        out = dt.train_step({"img": img, "label": lbl},
+                            extra_fetch=[outs["avg_cost"]])
+        losses.append(float(np.asarray(out[0]).ravel()[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_launch_single_host_and_mesh():
+    from paddle_tpu.distributed import launch
+
+    launch.init_multihost()  # single host: no-op success
+    assert launch.is_initialized()
+    mesh = launch.global_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] * 2 == len(jax.devices())
+    with pytest.raises(ValueError, match="devices"):
+        launch.global_mesh({"dp": 3, "tp": 5})
+    with pytest.raises(ValueError, match="one mesh axis"):
+        launch.global_mesh({"dp": -1, "tp": -1})
