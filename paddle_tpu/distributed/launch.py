@@ -42,7 +42,14 @@ def init_multihost(coordinator=None, num_processes=None, process_id=None,
     global _initialized
     import jax
 
+    explicit = coordinator is not None or num_processes is not None
     if _initialized:
+        if explicit:
+            # a silent no-op here would strand N hosts training alone
+            raise RuntimeError(
+                "init_multihost() already ran (single-host or autodetect); "
+                "call it with explicit arguments BEFORE any other "
+                "init_multihost()/JAX backend use")
         return
     coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if num_processes is None:
@@ -52,6 +59,12 @@ def init_multihost(coordinator=None, num_processes=None, process_id=None,
         env = os.environ.get("JAX_PROCESS_ID")
         process_id = int(env) if env else None
 
+    if process_id is not None and (coordinator is None
+                                   and num_processes in (None, 1)):
+        raise ValueError(
+            "JAX_PROCESS_ID/process_id is set but coordinator address and "
+            "num_processes are not — partial multi-host configuration; "
+            "set JAX_COORDINATOR_ADDRESS and JAX_NUM_PROCESSES too")
     if coordinator is None and num_processes in (None, 1):
         if _looks_like_pod():
             # cloud TPU pod: jax autodetects everything from metadata.
