@@ -25,6 +25,7 @@ import os
 __all__ = ["init_multihost", "global_mesh", "is_initialized"]
 
 _initialized = False
+_init_args = (None, None, None)
 
 
 def is_initialized():
@@ -39,18 +40,24 @@ def init_multihost(coordinator=None, num_processes=None, process_id=None,
     set the standard env vars, autodetection does the right thing.
     Single-process calls are a no-op success so the same script runs
     unmodified on one host."""
-    global _initialized
+    global _initialized, _init_args
     import jax
 
     explicit = coordinator is not None or num_processes is not None
     if _initialized:
-        if explicit:
-            # a silent no-op here would strand N hosts training alone
+        args = (coordinator, num_processes, process_id)
+        if explicit and args != _init_args and _init_args == (None,) * 3:
+            # the earlier init was a single-host/autodetect no-op — a
+            # silent no-op here would strand N hosts training alone
             raise RuntimeError(
-                "init_multihost() already ran (single-host or autodetect); "
+                "init_multihost() already ran without coordinator args; "
                 "call it with explicit arguments BEFORE any other "
                 "init_multihost()/JAX backend use")
-        return
+        if explicit and _init_args != (None,) * 3 and args != _init_args:
+            raise RuntimeError(
+                f"init_multihost() already initialized with {_init_args}; "
+                f"conflicting re-init with {args}")
+        return  # idempotent: same args (or defaulted) -> no-op
     coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if num_processes is None:
         env = os.environ.get("JAX_NUM_PROCESSES")
@@ -59,11 +66,14 @@ def init_multihost(coordinator=None, num_processes=None, process_id=None,
         env = os.environ.get("JAX_PROCESS_ID")
         process_id = int(env) if env else None
 
-    if process_id is not None and (coordinator is None
-                                   and num_processes in (None, 1)):
+    if (process_id not in (None, 0) and coordinator is None
+            and num_processes in (None, 1) and not _looks_like_pod()):
+        # a non-zero rank with no coordinator/world-size is unambiguous
+        # evidence of a broken multi-host launch; rank 0 alone (or pod
+        # metadata present) is a consistent single-host/autodetect setup
         raise ValueError(
-            "JAX_PROCESS_ID/process_id is set but coordinator address and "
-            "num_processes are not — partial multi-host configuration; "
+            "process_id/JAX_PROCESS_ID > 0 but coordinator address and "
+            "num_processes are not set — partial multi-host configuration; "
             "set JAX_COORDINATOR_ADDRESS and JAX_NUM_PROCESSES too")
     if coordinator is None and num_processes in (None, 1):
         if _looks_like_pod():
@@ -88,6 +98,7 @@ def init_multihost(coordinator=None, num_processes=None, process_id=None,
         process_id=process_id,
         local_device_ids=local_device_ids,
     )
+    _init_args = (coordinator, num_processes, process_id)
     _initialized = True
 
 

@@ -52,27 +52,41 @@ def depthwise_conv2d(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(
     )
 
 
-@register_op("conv2d_transpose")
-def conv2d_transpose(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(1, 1), **_):
+def _conv_transpose_nd(Input, Filter, strides, paddings, dilations, nd, dn):
     """Fractionally-strided conv: lhs_dilation by stride + spatially-flipped
     kernel, the gradient-of-conv formulation (reference
-    conv_transpose_op.cc).  Filter layout is (C_in, C_out, H, W)."""
-    s, p, d = _pair(strides), _pair(paddings), _pair(dilations)
-    w = jnp.swapaxes(Filter.astype(Input.dtype), 0, 1)[:, :, ::-1, ::-1]
-    kh, kw = w.shape[2], w.shape[3]
+    conv_transpose_op.cc).  Filter layout is (C_in, C_out, *spatial)."""
+    s = _pair(strides, nd)
+    p = _pair(paddings, nd)
+    d = _pair(dilations, nd)
+    flip = (slice(None), slice(None)) + (slice(None, None, -1),) * nd
+    w = jnp.swapaxes(Filter.astype(Input.dtype), 0, 1)[flip]
     # transpose-conv implicit padding on the dilated kernel extent
-    pad_h = d[0] * (kh - 1) - p[0]
-    pad_w = d[1] * (kw - 1) - p[1]
+    pads = [(d[i] * (w.shape[2 + i] - 1) - p[i],) * 2 for i in range(nd)]
     out = jax.lax.conv_general_dilated(
         Input,
         w,
-        window_strides=(1, 1),
-        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        window_strides=(1,) * nd,
+        padding=pads,
         lhs_dilation=s,
         rhs_dilation=d,
-        dimension_numbers=_CONV_DN,
+        dimension_numbers=dn,
     )
-    return {"Output": out.astype(Input.dtype)}
+    return out.astype(Input.dtype)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(Input, Filter, strides=(1, 1), paddings=(0, 0), dilations=(1, 1), **_):
+    return {"Output": _conv_transpose_nd(Input, Filter, strides, paddings,
+                                         dilations, 2, _CONV_DN)}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(Input, Filter, strides=(1, 1, 1), paddings=(0, 0, 0),
+                     dilations=(1, 1, 1), **_):
+    return {"Output": _conv_transpose_nd(
+        Input, Filter, strides, paddings, dilations, 3,
+        ("NCDHW", "OIDHW", "NCDHW"))}
 
 
 @register_op("conv3d")
@@ -101,21 +115,24 @@ def conv_shift(X, Y, **_):
     return {"Out": jnp.einsum("bwm,bm->bw", gathered, Y)}
 
 
-def _pool2d(X, ksize, strides, paddings, pooling_type, global_pooling, ceil_mode=False, exclusive=True):
-    k, s, p = _pair(ksize), _pair(strides), _pair(paddings)
+def _pool_nd(X, k, s, p, pooling_type, global_pooling, ceil_mode=False,
+             exclusive=True):
+    """Shared N-spatial-dim pooling core (NC + spatial layout)."""
+    nd = X.ndim - 2
     if global_pooling:
         k = X.shape[2:]
-        p = (0, 0)
+        p = (0,) * nd
     window = (1, 1) + tuple(k)
     stride = (1, 1) + tuple(s)
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    pads = ((0, 0), (0, 0)) + tuple((p[i], p[i]) for i in range(nd))
     if ceil_mode:
-        extra = []
-        for i in range(2):
+        hi = []
+        for i in range(nd):
             size = X.shape[2 + i] + 2 * p[i] - k[i]
             rem = size % s[i]
-            extra.append((s[i] - rem) % s[i] if rem else 0)
-        pads = ((0, 0), (0, 0), (p[0], p[0] + extra[0]), (p[1], p[1] + extra[1]))
+            hi.append((s[i] - rem) % s[i] if rem else 0)
+        pads = ((0, 0), (0, 0)) + tuple(
+            (p[i], p[i] + hi[i]) for i in range(nd))
     # NOTE: init values must be Python scalars so JAX recognizes the monoid
     # and emits reduce_window_max/_sum primitives (which have linearization
     # rules); an Array init falls back to generic reduce_window, which
@@ -132,6 +149,12 @@ def _pool2d(X, ksize, strides, paddings, pooling_type, global_pooling, ceil_mode
     return summed / counts
 
 
+def _pool2d(X, ksize, strides, paddings, pooling_type, global_pooling,
+            ceil_mode=False, exclusive=True):
+    return _pool_nd(X, _pair(ksize), _pair(strides), _pair(paddings),
+                    pooling_type, global_pooling, ceil_mode, exclusive)
+
+
 @register_op("pool2d")
 def pool2d(
     X,
@@ -145,6 +168,24 @@ def pool2d(
     **_,
 ):
     return {"Out": _pool2d(X, ksize, strides, paddings, pooling_type, global_pooling, ceil_mode, exclusive)}
+
+
+@register_op("pool3d")
+def pool3d(
+    X,
+    ksize=(2, 2, 2),
+    strides=(1, 1, 1),
+    paddings=(0, 0, 0),
+    pooling_type="max",
+    global_pooling=False,
+    ceil_mode=False,
+    exclusive=True,
+    **_,
+):
+    # reference pool_op.cc:298 pool3d (NCDHW)
+    return {"Out": _pool_nd(X, _pair(ksize, 3), _pair(strides, 3),
+                            _pair(paddings, 3), pooling_type,
+                            global_pooling, ceil_mode, exclusive)}
 
 
 @register_op("max_pool2d_with_index", nondiff=True)

@@ -13,8 +13,8 @@ ALIASES = {
                 "equal", "not_equal"],
     "logical": ["logical_and", "logical_or", "logical_not", "logical_xor"],
     "conv": ["conv2d", "conv3d", "depthwise_conv2d"],
-    "conv_transpose": ["conv2d_transpose"],
-    "pool": ["pool2d"],
+    "conv_transpose": ["conv2d_transpose", "conv3d_transpose"],
+    "pool": ["pool2d", "pool3d"],
     "pool_with_index": ["max_pool2d_with_index"],
     "reduce": ["reduce_sum", "reduce_mean", "reduce_max", "reduce_min"],
     "fill": ["fill_constant"],

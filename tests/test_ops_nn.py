@@ -245,3 +245,29 @@ def test_unpool_explicit_output_size():
                  "output_size": [10, 10]})
     assert up["Out"].shape == (1, 1, 10, 10)
     assert np.asarray(up["Out"])[0, 0, 8, 8] == 100.0
+
+
+def test_pool3d_max_and_avg():
+    x = rng.randn(2, 3, 4, 6, 6).astype(np.float32)
+    out = run_op("pool3d", {"X": x},
+                 attrs={"ksize": (2, 2, 2), "strides": (2, 2, 2),
+                        "paddings": (0, 0, 0), "pooling_type": "max"})["Out"]
+    want = x.reshape(2, 3, 2, 2, 3, 2, 3, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6, atol=1e-6)
+    out = run_op("pool3d", {"X": x},
+                 attrs={"ksize": (2, 2, 2), "strides": (2, 2, 2),
+                        "paddings": (0, 0, 0), "pooling_type": "avg"})["Out"]
+    want = x.reshape(2, 3, 2, 2, 3, 2, 3, 2).mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+    check_grad("pool3d", {"X": x},
+               "X", attrs={"pooling_type": "avg"})
+
+
+def test_conv3d_transpose_shape_and_grad():
+    x = rng.randn(1, 2, 3, 4, 4).astype(np.float32)
+    w = rng.randn(2, 3, 2, 2, 2).astype(np.float32)  # (Cin, Cout, D, H, W)
+    out = run_op("conv3d_transpose", {"Input": x, "Filter": w},
+                 attrs={"strides": (2, 2, 2)})["Output"]
+    assert np.asarray(out).shape == (1, 3, 6, 8, 8)
+    check_grad("conv3d_transpose", {"Input": x, "Filter": w}, "Filter",
+               attrs={"strides": (2, 2, 2)}, output="Output")
