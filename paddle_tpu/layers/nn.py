@@ -21,6 +21,8 @@ __all__ = [
     "lstm_unit",
     "conv2d",
     "conv2d_transpose",
+    "conv3d",
+    "pool3d",
     "pool2d",
     "batch_norm",
     "layer_norm",
@@ -74,6 +76,27 @@ __all__ = [
     "maxout",
     "spp",
 ]
+
+
+def _ntuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _conv_osize(i, k, s, p, d=1):
+    """Conv output extent (floor mode); -1 stays dynamic."""
+    if i < 0:
+        return -1
+    eff = (k - 1) * d + 1
+    return (i + 2 * p - eff) // s + 1
+
+
+def _pool_osize(i, k, s, p, ceil_mode=False, global_pooling=False):
+    if global_pooling:
+        return 1
+    if i < 0:
+        return -1
+    num = i + 2 * p - k
+    return (num + s - 1) // s + 1 if ceil_mode else num // s + 1
 
 
 def _seq_inputs(inputs, x):
@@ -321,11 +344,9 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, act=None, name=None):
     helper = LayerHelper("conv2d", bias_attr=bias_attr, act=act, name=name)
-    if isinstance(filter_size, int):
-        filter_size = (filter_size, filter_size)
-    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
-    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
-    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    filter_size = _ntuple(filter_size, 2)
+    stride, padding = _ntuple(stride, 2), _ntuple(padding, 2)
+    dilation = _ntuple(dilation, 2)
     cin = input.shape[1]
     w = helper.create_parameter(
         param_attr,
@@ -333,15 +354,8 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         dtype=input.dtype,
         default_initializer=init_mod.MSRA(uniform=False),
     )
-
-    def osize(i, k, s, p, d):
-        if i < 0:
-            return -1
-        eff = (k - 1) * d + 1
-        return (i + 2 * p - eff) // s + 1
-
-    oh = osize(input.shape[2], filter_size[0], stride[0], padding[0], dilation[0])
-    ow = osize(input.shape[3], filter_size[1], stride[1], padding[1], dilation[1])
+    oh = _conv_osize(input.shape[2], filter_size[0], stride[0], padding[0], dilation[0])
+    ow = _conv_osize(input.shape[3], filter_size[1], stride[1], padding[1], dilation[1])
     pre_bias = helper.create_tmp_variable(
         input.dtype, [input.shape[0], num_filters, oh, ow]
     )
@@ -358,6 +372,68 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     )
     pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    """5-D (NCDHW) convolution (reference conv_op.cc conv3d)."""
+    helper = LayerHelper("conv3d", bias_attr=bias_attr, act=act, name=name)
+    filter_size = _ntuple(filter_size, 3)
+    stride, padding = _ntuple(stride, 3), _ntuple(padding, 3)
+    dilation = _ntuple(dilation, 3)
+    cin = input.shape[1]
+    w = helper.create_parameter(
+        param_attr,
+        shape=[num_filters, cin // groups, *filter_size],
+        dtype=input.dtype,
+        default_initializer=init_mod.MSRA(uniform=False),
+    )
+    spatial = [
+        _conv_osize(input.shape[2 + i], filter_size[i], stride[i],
+                    padding[i], dilation[i])
+        for i in range(3)
+    ]
+    pre_bias = helper.create_tmp_variable(
+        input.dtype, [input.shape[0], num_filters, *spatial]
+    )
+    helper.append_op(
+        type="conv3d",
+        inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Output": [pre_bias.name]},
+        attrs={
+            "strides": list(stride),
+            "paddings": list(padding),
+            "dilations": list(dilation),
+            "groups": groups,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, name=None):
+    """5-D (NCDHW) pooling (reference pool_op.cc pool3d)."""
+    helper = LayerHelper("pool3d", name=name)
+    k = _ntuple(pool_size, 3)
+    s, p = _ntuple(pool_stride, 3), _ntuple(pool_padding, 3)
+    spatial = [
+        _pool_osize(input.shape[2 + i], k[i], s[i], p[i], ceil_mode,
+                    global_pooling)
+        for i in range(3)
+    ]
+    out = helper.create_tmp_variable(
+        input.dtype, [input.shape[0], input.shape[1], *spatial]
+    )
+    helper.append_op(
+        type="pool3d",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"ksize": list(k), "strides": list(s), "paddings": list(p),
+               "pooling_type": pool_type, "global_pooling": global_pooling,
+               "ceil_mode": ceil_mode},
+    )
+    return out
 
 
 def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
@@ -401,21 +477,12 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
 def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, ceil_mode=False, name=None):
     helper = LayerHelper("pool2d", name=name)
-    k = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
-    s = (pool_stride, pool_stride) if isinstance(pool_stride, int) else tuple(pool_stride)
-    p = (pool_padding, pool_padding) if isinstance(pool_padding, int) else tuple(pool_padding)
-
-    def osize(i, kk, ss, pp):
-        if i < 0:
-            return -1
-        if global_pooling:
-            return 1
-        if ceil_mode:
-            return (i + 2 * pp - kk + ss - 1) // ss + 1
-        return (i + 2 * pp - kk) // ss + 1
-
-    oh = osize(input.shape[2], k[0], s[0], p[0])
-    ow = osize(input.shape[3], k[1], s[1], p[1])
+    k = _ntuple(pool_size, 2)
+    s, p = _ntuple(pool_stride, 2), _ntuple(pool_padding, 2)
+    oh = _pool_osize(input.shape[2], k[0], s[0], p[0], ceil_mode,
+                     global_pooling)
+    ow = _pool_osize(input.shape[3], k[1], s[1], p[1], ceil_mode,
+                     global_pooling)
     out = helper.create_tmp_variable(input.dtype, [input.shape[0], input.shape[1], oh, ow])
     helper.append_op(
         type="pool2d",

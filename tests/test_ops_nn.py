@@ -271,3 +271,20 @@ def test_conv3d_transpose_shape_and_grad():
     assert np.asarray(out).shape == (1, 3, 6, 8, 8)
     check_grad("conv3d_transpose", {"Input": x, "Filter": w}, "Filter",
                attrs={"strides": (2, 2, 2)}, output="Output")
+
+
+def test_conv3d_pool3d_layers():
+    import paddle_tpu as pt
+
+    x = pt.layers.data("x3", shape=[2, 6, 8, 8], dtype="float32")
+    h = pt.layers.conv3d(x, num_filters=4, filter_size=3, padding=1,
+                         act="relu")
+    p = pt.layers.pool3d(h, pool_size=2, pool_stride=2)
+    cost = pt.layers.mean(p * p)
+    pt.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.random.RandomState(0).randn(2, 2, 6, 8, 8).astype(np.float32)
+    (pv, cv) = exe.run(feed={"x3": xv}, fetch_list=[p, cost])
+    assert pv.shape == (2, 4, 3, 4, 4)
+    assert np.isfinite(cv).all()
