@@ -149,6 +149,11 @@ class OpDesc:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        # "forward" | "optimize": optimize-role ops (param/state updates
+        # appended by minimize, ModelAverage, ...) are stripped by
+        # clone(for_test=True); position alone can't distinguish them
+        # from eval-only ops appended after minimize.
+        self.role = "forward"
 
     def input_names(self):
         return [n for vs in self.inputs.values() for n in vs]
@@ -321,14 +326,21 @@ class Program:
 
     # -- transformations ---------------------------------------------------
     def clone(self, for_test=False):
-        """Deep copy; ``for_test=True`` flips ``is_test`` attrs (the analog
-        of the reference's inference_optimize, pybind.cc:299)."""
+        """Deep copy; ``for_test=True`` flips ``is_test`` attrs AND strips
+        everything from the backward marker on (grad, optimizer, and any
+        later state-update ops) so evaluating the clone cannot mutate
+        parameters (the analog of the reference's inference_optimize,
+        pybind.cc:299)."""
         p = copy.deepcopy(self)  # fresh _serial via __setstate__
         if for_test:
             for blk in p.blocks:
+                blk.ops = [op for op in blk.ops
+                           if getattr(op, "role", "forward") != "optimize"]
+                blk.backward_index = None
                 for op in blk.ops:
                     if "is_test" in op.attrs:
                         op.attrs["is_test"] = True
+            p._backward_info = {}
         return p
 
     def __setstate__(self, state):

@@ -79,7 +79,11 @@ __all__ = [
 
 
 def _ntuple(v, n):
-    return (v,) * n if isinstance(v, int) else tuple(v)
+    # mirror ops/nn_ops.py _pair: sequences pass through, any scalar
+    # (python or numpy int) broadcasts
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
 
 
 def _conv_osize(i, k, s, p, d=1):
@@ -440,19 +444,17 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
                      dilation=1, param_attr=None, bias_attr=None, act=None,
                      name=None):
     helper = LayerHelper("conv2d_transpose", bias_attr=bias_attr, act=act, name=name)
-    if isinstance(filter_size, int):
-        filter_size = (filter_size, filter_size)
-    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
-    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    filter_size = _ntuple(filter_size, 2)
+    stride, padding = _ntuple(stride, 2), _ntuple(padding, 2)
+    dilation = _ntuple(dilation, 2)
     cin = input.shape[1]
     w = helper.create_parameter(
         param_attr, shape=[cin, num_filters, filter_size[0], filter_size[1]],
         dtype=input.dtype,
     )
 
-    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
-
     def osize(i, k, s, p, d):
+        # transpose-conv output extent (inverse of _conv_osize)
         if i < 0:
             return -1
         eff = (k - 1) * d + 1

@@ -23,10 +23,13 @@ def _global_step(helper):
         shape=[1], dtype="float32", name=f"{helper.name}.step",
         initializer=init_mod.Constant(0.0),
     )
-    helper.append_op(
+    op = helper.append_op(
         type="increment", inputs={"X": [step.name]}, outputs={"Out": [step.name]},
         attrs={"step": 1.0},
     )
+    # training-state write: clone(for_test=True) must strip it, else every
+    # eval batch advances the schedule
+    op.role = "optimize"
     return step
 
 

@@ -22,8 +22,17 @@ __all__ = [
     "Adadelta", "RMSProp", "Ftrl", "SGDOptimizer", "MomentumOptimizer",
     "AdagradOptimizer", "AdamOptimizer", "AdamaxOptimizer",
     "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
-    "FtrlOptimizer",
+    "FtrlOptimizer", "ModelAverage",
 ]
+
+
+def _tag_optimize_ops(block):
+    """Mark every op from the backward marker on as optimize-role so
+    clone(for_test=True) strips exactly the training suffix."""
+    if block.backward_index is None:
+        return
+    for op in block.ops[block.backward_index:]:
+        op.role = "optimize"
 
 
 class Optimizer:
@@ -105,6 +114,7 @@ class Optimizer:
             block, [p for p, _ in params_grads], startup_program
         )
         ops = [self._append_optimize_op(block, pg) for pg in params_grads]
+        _tag_optimize_ops(block)
         return ops, params_grads
 
 
@@ -227,6 +237,7 @@ class AdamOptimizer(Optimizer):
             type="scale", inputs={"X": [self._beta2_pow.name]},
             outputs={"Out": [self._beta2_pow.name]}, attrs={"scale": self._beta2},
         )
+        _tag_optimize_ops(block)
         return ops, pgs
 
 
@@ -275,6 +286,7 @@ class AdamaxOptimizer(Optimizer):
             type="scale", inputs={"X": [self._beta1_pow.name]},
             outputs={"Out": [self._beta1_pow.name]}, attrs={"scale": self._beta1},
         )
+        _tag_optimize_ops(block)
         return ops, pgs
 
 
@@ -409,3 +421,98 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+
+
+class ModelAverage:
+    """Parameter averaging for evaluation (reference:
+    paddle/parameter/AverageOptimizer.h:23 — windowed averages applied at
+    test time; fluid later called this ModelAverage).  TPU-native form:
+    an exponential moving average updated INSIDE the jitted train step
+    (one fused multiply-add per parameter), swapped in/out of the Scope
+    around evaluation.
+
+        opt = optimizer.Adam(...); opt.minimize(cost)
+        ma = optimizer.ModelAverage(0.999)       # after minimize
+        ... train ...
+        with ma.apply():                          # params <- averages
+            evaluate()
+        # params restored
+    """
+
+    def __init__(self, average_decay=0.999, main_program=None,
+                 startup_program=None):
+        from .core.program import default_main_program
+        from .layers.layer_helper import LayerHelper
+
+        from .core.scope import global_scope
+
+        self.decay = float(average_decay)
+        program = main_program or default_main_program()
+        self.program = program
+        startup = startup_program or default_startup_program()
+        if program.global_block().backward_index is None:
+            raise RuntimeError(
+                "ModelAverage must be constructed AFTER optimizer."
+                "minimize(cost): the averages track post-update parameters")
+        scope = global_scope()
+        self.pairs = []  # (param_name, ema_name)
+        block = program.global_block()
+        first_new = len(block.ops)
+        for p in program.all_parameters():
+            ema_name = p.name + "@EMA"
+            block.create_var(name=ema_name, dtype=p.dtype,
+                             shape=list(p.shape), persistable=True)
+            sb = startup.global_block()
+            sb.create_var(name=ema_name, dtype=p.dtype,
+                          shape=list(p.shape), persistable=True)
+            # startup: ema starts equal to the freshly-initialized param
+            sb.append_op(type="assign", inputs={"X": [p.name]},
+                         outputs={"Out": [ema_name]})
+            if scope.find_var(p.name) is not None:
+                # startup already ran — seed the average directly so the
+                # next train step can read it
+                scope.set(ema_name, np.asarray(scope.get(p.name)))
+            helper = LayerHelper("model_average", main_program=program,
+                                 startup_program=startup)
+            scaled_e = helper.create_tmp_variable(p.dtype, list(p.shape))
+            helper.append_op(
+                type="scale", inputs={"X": [ema_name]},
+                outputs={"Out": [scaled_e.name]},
+                attrs={"scale": self.decay, "bias": 0.0})
+            scaled_p = helper.create_tmp_variable(p.dtype, list(p.shape))
+            helper.append_op(
+                type="scale", inputs={"X": [p.name]},
+                outputs={"Out": [scaled_p.name]},
+                attrs={"scale": 1.0 - self.decay, "bias": 0.0})
+            helper.append_op(
+                type="elementwise_add",
+                inputs={"X": [scaled_e.name], "Y": [scaled_p.name]},
+                outputs={"Out": [ema_name]})
+            self.pairs.append((p.name, ema_name))
+        for op in block.ops[first_new:]:
+            op.role = "optimize"  # stripped from clone(for_test=True)
+
+    def apply(self, scope=None, need_restore=True):
+        """Context manager: swap averaged values into the params."""
+        import contextlib
+
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+
+        @contextlib.contextmanager
+        def ctx():
+            # host copies: any run() inside the context donates the device
+            # buffers currently in the scope, so saved references to them
+            # would be dead by restore time
+            saved = {p: np.asarray(scope.get(p)) for p, _ in self.pairs}
+            for p, e in self.pairs:
+                scope.set(p, np.asarray(scope.get(e)))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for p, _ in self.pairs:
+                        scope.set(p, saved[p])
+
+        return ctx()

@@ -179,3 +179,54 @@ def test_static_rnn_cumsum():
     data = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
     (got,) = exe.run(feed={"x": data}, fetch_list=[out])
     np.testing.assert_allclose(got, np.cumsum(data, axis=1))
+
+
+def test_clone_for_test_does_not_train():
+    """clone(for_test=True) strips grad/optimizer/update ops: evaluating
+    the clone must never mutate parameters (reference inference_optimize
+    semantics; regression — Trainer.test previously ran the update)."""
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, 1, bias_attr=False)
+    cost = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.scope.global_scope()
+    pname = pt.default_main_program().all_parameters()[0].name
+    before = np.asarray(scope.get(pname)).copy()
+
+    test_prog = pt.default_main_program().clone(for_test=True)
+    xv = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    yv = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+    (c,) = exe.run(test_prog, feed={"x": xv, "y": yv}, fetch_list=[cost])
+    assert np.isfinite(c).all()
+    np.testing.assert_array_equal(np.asarray(scope.get(pname)), before)
+    # the original program still trains
+    exe.run(feed={"x": xv, "y": yv}, fetch_list=[cost])
+    assert not np.allclose(np.asarray(scope.get(pname)), before)
+
+
+def test_clone_for_test_freezes_lr_schedule():
+    """Eval on a test clone must not advance the LR schedule's step
+    counter (regression: the increment op is forward-positioned)."""
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, 1, bias_attr=False)
+    cost = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    lr = pt.learning_rate_decay.exponential_decay(0.1, 10, 0.5)
+    pt.optimizer.SGD(learning_rate=lr).minimize(cost)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.scope.global_scope()
+    step_name = next(n for n in scope._vars if n.endswith(".step"))
+
+    xv = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    yv = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+    exe.run(feed={"x": xv, "y": yv}, fetch_list=[cost])
+    after_train = float(np.asarray(scope.get(step_name)).ravel()[0])
+    test_prog = pt.default_main_program().clone(for_test=True)
+    exe.run(test_prog, feed={"x": xv, "y": yv}, fetch_list=[cost])
+    exe.run(test_prog, feed={"x": xv, "y": yv}, fetch_list=[cost])
+    after_eval = float(np.asarray(scope.get(step_name)).ravel()[0])
+    assert after_train == after_eval, (after_train, after_eval)

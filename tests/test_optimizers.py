@@ -98,3 +98,73 @@ def test_lr_decay_schedule():
         (v,) = exe.run(feed={"x": np.ones((2, 2), np.float32)}, fetch_list=[lr])
         vals.append(float(v[0]))
     np.testing.assert_allclose(vals, [0.5, 0.25, 0.125], rtol=1e-5)
+
+
+def test_model_average_ema_and_apply():
+    """ModelAverage (reference AverageOptimizer.h:23): EMA updated inside
+    the jitted step; apply() swaps averages in for eval and restores."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, 1, bias_attr=False)
+    cost = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    ma = pt.optimizer.ModelAverage(0.9)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.scope.global_scope()
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(16, 4)).astype(np.float32)
+    yv = xv @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+    for _ in range(20):
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[cost])
+
+    p, e = ma.pairs[0]
+    pv, ev = np.asarray(scope.get(p)), np.asarray(scope.get(e))
+    assert not np.allclose(pv, ev)  # ema lags the raw weights
+    with ma.apply():
+        np.testing.assert_allclose(np.asarray(scope.get(p)), ev)
+    np.testing.assert_allclose(np.asarray(scope.get(p)), pv)
+
+
+def test_model_average_matches_hand_rolled_ema():
+    """The in-step EMA must equal decay*ema + (1-decay)*param applied to
+    the POST-update parameter each step."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    x = layers.data("x", shape=[2])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, 1, bias_attr=False)
+    cost = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.05).minimize(cost)
+    ma = pt.optimizer.ModelAverage(0.8)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.scope.global_scope()
+    pname, ename = ma.pairs[0]
+
+    rng = np.random.default_rng(1)
+    xv = rng.normal(size=(8, 2)).astype(np.float32)
+    yv = xv @ np.array([[2.0], [-1.0]], np.float32)
+    hand = np.asarray(scope.get(pname)).copy()  # startup seeds ema = param
+    for _ in range(6):
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[cost])
+        hand = 0.8 * hand + 0.2 * np.asarray(scope.get(pname))
+    np.testing.assert_allclose(np.asarray(scope.get(ename)), hand,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_model_average_requires_minimize_first():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    layers.fc(layers.data("x", shape=[2]), 1)
+    try:
+        pt.optimizer.ModelAverage(0.9)
+        assert False, "expected RuntimeError before minimize"
+    except RuntimeError as e:
+        assert "minimize" in str(e)
