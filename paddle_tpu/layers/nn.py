@@ -72,6 +72,7 @@ __all__ = [
     "clip",
     "clip_by_norm",
     "beam_search",
+    "beam_search_decode",
     "lrn",
     "maxout",
     "spp",
@@ -1329,6 +1330,30 @@ def beam_search(pre_ids, pre_scores, scores, beam_size, end_id):
         attrs={"beam_size": beam_size, "end_id": end_id},
     )
     return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, parent_idx, scores=None, end_id=1, name=None):
+    """Backtrack stacked per-step beams [T, b, k] into sentences
+    [b, k, T] (+ final scores) — reference beam_search_decode_op.cc via
+    fluid layers/control_flow.py beam_search_decode."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    t, b, k = ids.shape[0], ids.shape[1], ids.shape[2]
+    sent = helper.create_tmp_variable("int64", [b, k, t], stop_gradient=True)
+    outputs = {"SentenceIds": [sent.name]}
+    inputs = {"Ids": [ids.name], "ParentIdx": [parent_idx.name]}
+    out_scores = None
+    if scores is not None:
+        inputs["Scores"] = [scores.name]
+        out_scores = helper.create_tmp_variable("float32", [b, k],
+                                                stop_gradient=True)
+        outputs["SentenceScores"] = [out_scores.name]
+    helper.append_op(
+        type="beam_search_decode",
+        inputs=inputs,
+        outputs=outputs,
+        attrs={"end_id": end_id},
+    )
+    return (sent, out_scores) if scores is not None else sent
 
 
 def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):

@@ -5,6 +5,7 @@ layer alone (test_ProtoServer.cpp), master with the in-mem store
 (go/pserver/etcd_client_test.go)."""
 
 import pickle
+import subprocess
 import threading
 import time
 
@@ -370,35 +371,61 @@ def test_launch_single_host_and_mesh():
         launch.global_mesh({"dp": -1, "tp": -1})
 
 
+def _reap(procs):
+    """Terminate subprocess(es), never raising out of a finally block."""
+    if not isinstance(procs, (list, tuple)):
+        procs = [procs]
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+
+
 def _spawn_cli(cli_args, store_path):
-    """Spawn `python -m paddle_tpu <args>` and wait (bounded) for its
+    """Spawn `python -m paddle_tpu <args>` and wait (bounded even if the
+    child hangs silently: stdout is drained on a helper thread) for its
     'serving on <endpoint>' line; returns (proc, endpoint)."""
     import os
+    import queue
     import re
-    import subprocess
     import sys
 
+    repo_root = os.path.dirname(os.path.dirname(__file__))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    prev = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = repo_root + (os.pathsep + prev if prev else "")
     p = subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu", *cli_args,
          "--store", str(store_path)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+
+    q = queue.Queue()
+
+    def drain():
+        for line in p.stdout:
+            q.put(line)
+        q.put(None)
+
+    threading.Thread(target=drain, daemon=True).start()
     deadline = time.time() + 60
     lines = []
     while time.time() < deadline:
-        if p.poll() is not None:
+        try:
+            line = q.get(timeout=max(0.1, deadline - time.time()))
+        except queue.Empty:
             break
-        line = p.stdout.readline()
-        if not line:
+        if line is None:
             break
         lines.append(line)
         m = re.search(r"serving on (\S+)", line)
         if m:
             return p, m.group(1)
-    p.terminate()
-    p.wait(timeout=10)
+    _reap(p)
     raise AssertionError(f"no endpoint from {cli_args}: {lines!r}")
 
 
@@ -428,10 +455,7 @@ def test_cli_pserver_processes_end_to_end(tmp_path):
             np.testing.assert_allclose(
                 fresh[k], w[k] - 0.1 * 3 * np.ones_like(w[k]), rtol=1e-5)
     finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            p.wait(timeout=10)
+        _reap(procs)
 
 
 def test_cli_master_process_end_to_end(tmp_path):
@@ -450,5 +474,4 @@ def test_cli_master_process_end_to_end(tmp_path):
             got.append(rec)
         assert sorted(got) == sorted(all_recs)
     finally:
-        p.terminate()
-        p.wait(timeout=10)
+        _reap(p)

@@ -454,3 +454,34 @@ def test_norm_and_spp_and_conv_shift():
     ker = np.random.RandomState(15).rand(2, 3).astype(np.float32)
     got = run_op("conv_shift", {"X": xs, "Y": ker})
     assert got["Out"].shape == (2, 5)
+
+
+def test_beam_search_decode_layer():
+    import paddle_tpu as pt
+
+    # layers.data prepends a dynamic leading dim -> [T, b, k] feeds
+    ids = pt.layers.data("bs_ids", shape=[2, 3], dtype="int64")
+    parent = pt.layers.data("bs_parent", shape=[2, 3], dtype="int64")
+    scores = pt.layers.data("bs_scores", shape=[2, 3], dtype="float32")
+    sent, out_scores = pt.layers.beam_search_decode(
+        ids, parent, scores=scores, end_id=1)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    T, b, k = 4, 2, 3
+    rng = np.random.default_rng(0)
+    idsv = rng.integers(2, 9, (T, b, k)).astype(np.int64)
+    parentv = rng.integers(0, k, (T, b, k)).astype(np.int64)
+    # scores at final step only matter
+    scoresv = rng.random((T, b, k)).astype(np.float32)
+    sv, scv = exe.run(feed={"bs_ids": idsv, "bs_parent": parentv,
+                            "bs_scores": scoresv},
+                      fetch_list=[sent, out_scores])
+    assert sv.shape == (b, k, T)
+    assert scv.shape == (b, k)
+    # hand backtrack beam 0 of batch 0
+    beam = 0
+    toks = []
+    for t in range(T - 1, -1, -1):
+        toks.append(idsv[t, 0, beam])
+        beam = parentv[t, 0, beam]
+    assert sv[0, 0, :].tolist() == toks[::-1]
