@@ -57,16 +57,6 @@ class GradientClipByGlobalNorm:
         self.clip_norm = clip_norm
 
 
-def error_clip_callback(block, var, max=None, min=None):
-    """ErrorClipByValue analog: clip an activation's gradient.  With jax.grad
-    there are no intermediate grad vars to clip, so error clip applies to
-    the variable's *forward* value contribution via clip op on the var."""
-    block.append_op(
-        type="clip", inputs={"X": [var.name]}, outputs={"Out": [var.name]},
-        attrs={"min": float(min if min is not None else -max), "max": float(max)},
-    )
-
-
 def append_gradient_clip_ops(param_grads, global_clip=None):
     """Apply per-param gradient_clip_attr, or a GradientClipByGlobalNorm over
     the whole list."""
@@ -121,3 +111,60 @@ def append_gradient_clip_ops(param_grads, global_clip=None):
             g = clip_attr._append_clip_op(p.block, g)
         result.append((p, g))
     return result
+
+
+class ErrorClipByValue:
+    """Clip the backpropagated error at a variable to [min, max]
+    (reference fluid/clip.py:37 ErrorClipByValue)."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else None
+
+
+def error_clip_callback(var, clip_attr):
+    """Apply an ErrorClipByValue to ``var``: rewrites the program so the
+    gradient flowing back through ``var`` is clipped, leaving the forward
+    value unchanged.
+
+    The reference rewrites the grad-op list (clip.py error_clip_callback);
+    here gradients come from tracing, so the rewrite inserts an identity
+    op with a clipped-cotangent custom VJP right after ``var``'s producer
+    and points all later consumers at it.
+    """
+    from .core.program import OpDesc
+
+    block = var.block
+    producer = None
+    for i, op in enumerate(block.ops):
+        if var.name in op.output_names():
+            producer = i
+    if producer is None:
+        raise ValueError(f"{var.name!r} has no producing op in its block")
+    clipped = _tmp_like(block, var, "error_clip")
+    clipped.stop_gradient = False
+    for op in block.ops[producer + 1:]:
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [
+                clipped.name if n == var.name else n for n in names
+            ]
+    attrs = {"max": clip_attr.max}
+    if clip_attr.min is not None:
+        attrs["min"] = clip_attr.min
+    pos = producer + 1
+    block.ops.insert(
+        pos,
+        OpDesc("error_clip", {"X": [var.name]}, {"Out": [clipped.name]},
+               attrs),
+    )
+    # keep the forward/backward split (and any remat segment indices)
+    # pointing at the same ops after the insert
+    if block.backward_index is not None and pos <= block.backward_index:
+        block.backward_index += 1
+    segs = getattr(block.program, "_remat_segments", None)
+    if segs:
+        block.program._remat_segments = [
+            (s + (pos <= s), t_ + (pos <= t_)) for s, t_ in segs
+        ]
+    block.program._bump_version()
+    return clipped

@@ -9,6 +9,8 @@ accumulate in float32 via ``preferred_element_type`` so bfloat16 inputs keep
 MXU-native speed without losing accumulation precision.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -212,3 +214,29 @@ def norm(X, Input=None, epsilon=1e-10, **_):
 def maxout(X, groups=2, **_):
     n, c, h, w = X.shape
     return {"Out": jnp.max(X.reshape(n, c // groups, groups, h, w), axis=2)}
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _identity_clip_grad(x, lo, hi):
+    return x
+
+
+def _icg_fwd(x, lo, hi):
+    return x, None
+
+
+def _icg_bwd(lo, hi, _res, g):
+    return (jnp.clip(g, lo, hi),)
+
+
+_identity_clip_grad.defvjp(_icg_fwd, _icg_bwd)
+
+
+@register_op("error_clip")
+def error_clip(X, max=1.0, min=None, **_):
+    # reference fluid/clip.py ErrorClipByValue: identity forward, the
+    # BACKPROPAGATED error through this point is clipped to [min, max] —
+    # realized as a custom-VJP identity (jax.grad sees the clipped
+    # cotangent exactly where the reference's backward rewrite clipped).
+    lo = -abs(float(max)) if min is None else float(min)
+    return {"Out": _identity_clip_grad(X, lo, float(max))}

@@ -156,3 +156,64 @@ def test_prelu():
     check_output("prelu", {"X": x, "Alpha": a},
                  {"Out": np.where(x >= 0, x, 0.25 * x)})
     check_grad("prelu", {"X": x + np.sign(x) * 0.1, "Alpha": a}, "Alpha")
+
+
+def test_error_clip():
+    """ErrorClipByValue: forward unchanged, backward error clipped at the
+    marked variable (reference fluid/clip.py:37)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+
+    # op level: grad of sum(10*x) through error_clip is clipped to 0.1
+    from paddle_tpu.core.registry import get_op_impl
+
+    impl = get_op_impl("error_clip").fn
+
+    def f(x):
+        y = impl(X=x, max=0.1)["Out"]
+        return jnp.sum(10.0 * y)
+
+    g = jax.grad(f)(jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(g), 0.1)
+
+    # program level: rewrite via error_clip_callback
+    x = pt.layers.data("x", shape=[4])
+    h = pt.layers.fc(x, 4, bias_attr=False, name="ec_fc")
+    out = pt.layers.scale(h, scale=100.0)
+    cost = pt.layers.reduce_sum(out)
+    clipped = pt.clip.error_clip_callback(h, pt.clip.ErrorClipByValue(0.01))
+    pt.optimizer.SGD(learning_rate=1.0).minimize(cost)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.scope.global_scope()
+    w0 = np.asarray(scope.get("ec_fc.w")).copy()
+    xv = np.ones((2, 4), np.float32)
+    exe.run(feed={"x": xv}, fetch_list=[cost])
+    w1 = np.asarray(scope.get("ec_fc.w"))
+    # dL/dW = x^T @ err, err clipped to 0.01 per element, batch 2 -> 0.02;
+    # unclipped would be 100 per element
+    np.testing.assert_allclose(w0 - w1, 0.02 * np.ones_like(w0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_error_clip_after_minimize_keeps_backward_split():
+    """Inserting the error-clip op after minimize must shift the
+    forward/backward boundary so the step still lowers correctly."""
+    import paddle_tpu as pt
+
+    x = pt.layers.data("x", shape=[4])
+    h = pt.layers.fc(x, 4, bias_attr=False, name="ec2_fc")
+    cost = pt.layers.reduce_sum(pt.layers.scale(h, scale=10.0))
+    pt.optimizer.SGD(learning_rate=1.0).minimize(cost)
+    pt.clip.error_clip_callback(h, pt.clip.ErrorClipByValue(0.01))
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.scope.global_scope()
+    w0 = np.asarray(scope.get("ec2_fc.w")).copy()
+    xv = np.ones((2, 4), np.float32)
+    (c,) = exe.run(feed={"x": xv}, fetch_list=[cost])
+    assert np.isfinite(c).all()
+    w1 = np.asarray(scope.get("ec2_fc.w"))
+    np.testing.assert_allclose(w0 - w1, 0.02 * np.ones_like(w0),
+                               rtol=1e-5, atol=1e-6)
