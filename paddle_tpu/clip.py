@@ -118,6 +118,10 @@ class ErrorClipByValue:
     (reference fluid/clip.py:37 ErrorClipByValue)."""
 
     def __init__(self, max, min=None):
+        if min is None and max <= 0:
+            raise ValueError(
+                f"ErrorClipByValue needs max > 0 when min is omitted "
+                f"(got max={max}); the range is [-max, max]")
         self.max = float(max)
         self.min = float(min) if min is not None else None
 

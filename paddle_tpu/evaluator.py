@@ -102,3 +102,178 @@ class ChunkEvaluator(Evaluator):
         recall = correct / max(label, 1e-12)
         f1 = 2 * precision * recall / max(precision + recall, 1e-12)
         return precision, recall, f1
+
+
+class EditDistance(Evaluator):
+    """Sequence error evaluator (reference CTCErrorEvaluator.cpp):
+    accumulates total edit distance and sequence counts in program state;
+    eval() returns (avg_distance, instance_error_rate)."""
+
+    def __init__(self, input, label, normalized=False, ignored_tokens=None,
+                 **kwargs):
+        super().__init__("edit_distance_eval", **kwargs)
+        self.total_distance = self._create_state("total_dist", "float32", [1])
+        self.seq_num = self._create_state("seq_num", "int64", [1])
+        self.errors = self._create_state("errors", "int64", [1])
+        dist, seq_num = layers.edit_distance(
+            input, label, normalized=normalized,
+            ignored_tokens=ignored_tokens)
+        batch_sum = layers.reduce_sum(dist)
+        wrong = layers.cast(
+            layers.greater_than(dist, layers.fill_constant(
+                shape=[1], dtype=dist.dtype, value=0.0)), "int64")
+        batch_err = layers.reduce_sum(wrong)
+        for state, batch in [(self.total_distance, batch_sum),
+                             (self.seq_num, seq_num),
+                             (self.errors, batch_err)]:
+            self.helper.append_op(
+                type="sum", inputs={"X": [state.name, batch.name]},
+                outputs={"Out": [state.name]},
+            )
+        self.metrics.append(dist)
+
+    def eval(self, executor=None):
+        scope = global_scope()
+        dist = float(np.asarray(scope.get(self.total_distance.name)).ravel()[0])
+        n = float(np.asarray(scope.get(self.seq_num.name)).ravel()[0])
+        err = float(np.asarray(scope.get(self.errors.name)).ravel()[0])
+        return dist / max(n, 1.0), err / max(n, 1.0)
+
+
+class Auc:
+    """Exact ROC-AUC over the whole evaluation set (reference
+    Evaluator.cpp AucEvaluator).  Dataset-level rank statistics cannot
+    accumulate in fixed-size program state, so this evaluator collects
+    fetched (score, label) batches host-side: call update() per batch,
+    eval() for the area."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self, executor=None):
+        self._scores = []
+        self._labels = []
+
+    def update(self, scores, labels):
+        s = np.asarray(scores, np.float64).reshape(-1)
+        l = np.asarray(labels).reshape(-1)
+        self._scores.append(s)
+        self._labels.append(l)
+
+    def eval(self, executor=None):
+        if not self._scores:
+            return 0.0
+        s = np.concatenate(self._scores)
+        l = np.concatenate(self._labels).astype(bool)
+        pos, neg = int(l.sum()), int((~l).sum())
+        if pos == 0 or neg == 0:
+            return 0.0
+        # rank-sum (Mann-Whitney U) with tie correction via average ranks
+        order = np.argsort(s, kind="mergesort")
+        ranks = np.empty(len(s), np.float64)
+        sorted_s = s[order]
+        i = 0
+        while i < len(s):
+            j = i
+            while j + 1 < len(s) and sorted_s[j + 1] == sorted_s[i]:
+                j += 1
+            ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
+            i = j + 1
+        return float((ranks[l].sum() - pos * (pos + 1) / 2.0) / (pos * neg))
+
+
+class DetectionMAP:
+    """VOC-style detection mAP (reference DetectionMAPEvaluator.cpp:
+    11point or integral AP, overlap threshold, per-class matching of
+    ranked detections to ground truth).  Host-side like Auc: call
+    update() per batch with fetched arrays, eval() for mAP.
+
+    update(detections, gt_boxes, gt_labels):
+      detections  [[label, score, x1, y1, x2, y2], ...] for ONE image
+      gt_boxes    [[x1, y1, x2, y2], ...]
+      gt_labels   [g] ints
+    """
+
+    def __init__(self, overlap_threshold=0.5, ap_version="integral",
+                 evaluate_difficult=False):
+        if ap_version not in ("integral", "11point"):
+            raise ValueError(f"unknown ap_version {ap_version!r}")
+        if evaluate_difficult:
+            raise NotImplementedError(
+                "difficult-box filtering is not implemented; update() takes "
+                "no difficult flags — pre-filter difficult GT boxes instead")
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self, executor=None):
+        self._images = []  # (dets, gt_boxes, gt_labels) per image
+
+    def update(self, detections, gt_boxes, gt_labels):
+        self._images.append((
+            np.asarray(detections, np.float64).reshape(-1, 6),
+            np.asarray(gt_boxes, np.float64).reshape(-1, 4),
+            np.asarray(gt_labels).reshape(-1).astype(int),
+        ))
+
+    @staticmethod
+    def _iou(box, boxes):
+        x1 = np.maximum(box[0], boxes[:, 0])
+        y1 = np.maximum(box[1], boxes[:, 1])
+        x2 = np.minimum(box[2], boxes[:, 2])
+        y2 = np.minimum(box[3], boxes[:, 3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        a = (box[2] - box[0]) * (box[3] - box[1])
+        b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / np.maximum(a + b - inter, 1e-12)
+
+    def _average_precision(self, tp, fp, n_gt):
+        tp, fp = np.cumsum(tp), np.cumsum(fp)
+        recall = tp / max(n_gt, 1)
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        if self.ap_version == "11point":
+            return float(np.mean([
+                precision[recall >= r].max() if (recall >= r).any() else 0.0
+                for r in np.linspace(0, 1, 11)
+            ]))
+        # integral: area under monotone precision envelope
+        mp = np.concatenate([[0.0], precision, [0.0]])
+        mr = np.concatenate([[0.0], recall, [1.0]])
+        for i in range(len(mp) - 2, -1, -1):
+            mp[i] = max(mp[i], mp[i + 1])
+        idx = np.where(mr[1:] != mr[:-1])[0]
+        return float(np.sum((mr[idx + 1] - mr[idx]) * mp[idx + 1]))
+
+    def eval(self, executor=None):
+        classes = sorted({c for _, _, gl in self._images for c in gl})
+        aps = []
+        for c in classes:
+            records = []  # (score, image_idx, box)
+            n_gt = 0
+            for i, (dets, gb, gl) in enumerate(self._images):
+                n_gt += int((gl == c).sum())
+                for d in dets[dets[:, 0] == c]:
+                    records.append((d[1], i, d[2:6]))
+            if n_gt == 0:
+                continue
+            records.sort(key=lambda r: -r[0])
+            matched = {i: np.zeros(int((gl == c).sum()), bool)
+                       for i, (_, _, gl) in enumerate(self._images)}
+            tp = np.zeros(len(records))
+            fp = np.zeros(len(records))
+            for k, (_score, i, box) in enumerate(records):
+                _, gb, gl = self._images[i]
+                cls_boxes = gb[gl == c]
+                if len(cls_boxes) == 0:
+                    fp[k] = 1
+                    continue
+                ious = self._iou(box, cls_boxes)
+                best = int(np.argmax(ious))
+                if ious[best] >= self.overlap_threshold and \
+                        not matched[i][best]:
+                    tp[k] = 1
+                    matched[i][best] = True
+                else:
+                    fp[k] = 1
+            aps.append(self._average_precision(tp, fp, n_gt))
+        return float(np.mean(aps)) if aps else 0.0

@@ -253,3 +253,69 @@ def test_trainer_async_checkpoint(tmp_path):
     # every published dir is complete (manifest present, crc valid)
     for p in os.listdir(tmp_path):
         assert os.path.exists(tmp_path / p / "__manifest__.pkl")
+
+
+def test_auc_evaluator_exact():
+    """Rank-sum AUC matches the closed-form on a hand case with ties."""
+    from paddle_tpu.evaluator import Auc
+
+    auc = Auc()
+    auc.update([0.9, 0.8, 0.8, 0.1], [1, 0, 1, 0])
+    # pairs (pos, neg): (0.9 vs 0.8)=1, (0.9 vs 0.1)=1, (0.8 vs 0.8)=0.5,
+    # (0.8 vs 0.1)=1 -> 3.5/4
+    assert abs(auc.eval() - 3.5 / 4) < 1e-9
+    auc.reset()
+    auc.update([0.2, 0.7], [0, 1])
+    assert auc.eval() == 1.0
+
+
+def test_detection_map_evaluator():
+    from paddle_tpu.evaluator import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=0.5, ap_version="integral")
+    # image 0: one GT of class 1; two detections — the higher-scored one
+    # matches (IoU=1), the lower is a false positive
+    m.update(detections=[[1, 0.9, 0, 0, 10, 10], [1, 0.6, 50, 50, 60, 60]],
+             gt_boxes=[[0, 0, 10, 10]], gt_labels=[1])
+    # precision/recall: after det1 tp (P=1, R=1), after det2 fp (P=0.5, R=1)
+    # integral AP = 1.0
+    assert abs(m.eval() - 1.0) < 1e-9
+    # add a second class with a miss: class 2 GT never detected -> AP 0
+    m.update(detections=[], gt_boxes=[[0, 0, 5, 5]], gt_labels=[2])
+    assert abs(m.eval() - 0.5) < 1e-9
+    # 11-point version on the same data
+    m11 = DetectionMAP(ap_version="11point")
+    m11.update(detections=[[1, 0.9, 0, 0, 10, 10]],
+               gt_boxes=[[0, 0, 10, 10]], gt_labels=[1])
+    assert abs(m11.eval() - 1.0) < 1e-9
+
+
+def test_edit_distance_evaluator():
+    """In-program accumulation across two batches of decoded vs label
+    sequences."""
+    from paddle_tpu.evaluator import EditDistance
+
+    hyp = pt.layers.data("hyp", shape=[4], dtype="int64", lod_level=1)
+    ref = pt.layers.data("ref", shape=[4], dtype="int64", lod_level=1)
+    ev = EditDistance(hyp, ref)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    ev.reset()
+
+    def feed(h, hl, r, rl):
+        return {"hyp": np.asarray(h, np.int64),
+                "hyp@LENGTH": np.asarray(hl, np.int32),
+                "ref": np.asarray(r, np.int64),
+                "ref@LENGTH": np.asarray(rl, np.int32)}
+
+    # batch 1: [1,2,3] vs [1,2,3] (d=0); [1,1,0,0] vs [2,2] (d=4... compute)
+    exe.run(feed=feed([[1, 2, 3, 0], [1, 1, 0, 0]], [3, 4],
+                      [[1, 2, 3, 0], [2, 2, 0, 0]], [3, 2]),
+            fetch_list=[ev.metrics[0]])
+    # batch 2: [5] vs [5,6] (d=1)
+    exe.run(feed=feed([[5, 0, 0, 0]], [1], [[5, 6, 0, 0]], [2]),
+            fetch_list=[ev.metrics[0]])
+    avg_dist, err_rate = ev.eval()
+    # distances: 0, edit([1,1,0,0],[2,2])=4, 1 -> avg 5/3; errors 2/3
+    assert abs(avg_dist - 5.0 / 3.0) < 1e-5
+    assert abs(err_rate - 2.0 / 3.0) < 1e-9
