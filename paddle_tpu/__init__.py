@@ -67,6 +67,7 @@ from .flags import FLAGS
 from . import memory_optimization_transpiler
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from . import compat
+from . import image
 from . import net_drawer
 from . import parameters
 from . import plot

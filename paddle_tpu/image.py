@@ -1,0 +1,179 @@
+"""Image preprocessing (reference: python/paddle/v2/image.py —
+resize_short, center/random crop, left_right_flip, to_chw,
+simple_transform, load_and_transform, batch_images_from_tar).
+
+Pure numpy (the reference shells out to cv2): bilinear resize, HWC
+in / CHW out conventions identical, so v2-era training scripts port
+unchanged.  Random ops take an optional ``rng`` for determinism.
+"""
+
+import tarfile
+
+import numpy as np
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform", "batch_images_from_tar",
+]
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """Decode an image from bytes.  PNG/JPEG need pillow or cv2 — if
+    neither is available only raw .npy payloads are supported."""
+    import io
+
+    try:
+        from PIL import Image
+
+        pil = Image.open(io.BytesIO(bytes_))
+        # normalize channels like cv2 IMREAD_COLOR/GRAYSCALE: always 3
+        # channels when is_color (grayscale/palette/RGBA included), else
+        # proper luma-weighted single channel
+        return np.asarray(pil.convert("RGB" if is_color else "L"))
+    except ImportError:
+        pass
+    try:
+        import cv2
+
+        flag = cv2.IMREAD_COLOR if is_color else cv2.IMREAD_GRAYSCALE
+        im = cv2.imdecode(np.frombuffer(bytes_, np.uint8), flag)
+        if im is None:
+            raise ValueError("cv2 could not decode image bytes")
+        if is_color:
+            im = im[:, :, ::-1]  # BGR -> RGB
+        return im
+    except ImportError:
+        return np.load(io.BytesIO(bytes_), allow_pickle=False)
+
+
+def load_image(file, is_color=True):
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _bilinear_resize(im, h, w):
+    """HWC (or HW) bilinear resize, numpy only."""
+    src_h, src_w = im.shape[:2]
+    if (src_h, src_w) == (h, w):
+        return im
+    ys = (np.arange(h) + 0.5) * src_h / h - 0.5
+    xs = (np.arange(w) + 0.5) * src_w / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, src_h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, src_w - 1)
+    y1 = np.minimum(y0 + 1, src_h - 1)
+    x1 = np.minimum(x0 + 1, src_w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :]
+    if im.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    p00 = im[np.ix_(y0, x0)].astype(np.float64)
+    p01 = im[np.ix_(y0, x1)].astype(np.float64)
+    p10 = im[np.ix_(y1, x0)].astype(np.float64)
+    p11 = im[np.ix_(y1, x1)].astype(np.float64)
+    out = (p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx
+           + p10 * wy * (1 - wx) + p11 * wy * wx)
+    if np.issubdtype(im.dtype, np.integer):
+        return np.rint(out).astype(im.dtype)  # round like cv2, no floor bias
+    return out.astype(np.float32)
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge becomes ``size`` (aspect preserved;
+    reference image.py:163)."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _bilinear_resize(im, size, int(round(w * size / h)))
+    return _bilinear_resize(im, int(round(h * size / w)), size)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = max((h - size) // 2, 0)
+    w0 = max((w - size) // 2, 0)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, max(h - size, 0) + 1)
+    w0 = rng.randint(0, max(w - size, 0) + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """Resize-short -> (random crop + maybe-flip | center crop) -> CHW
+    float32 -> optional mean subtract (reference image.py:291)."""
+    rng = rng or np.random
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color, rng=rng)
+        if rng.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1 and is_color and im.ndim == 3:
+            mean = mean[:, None, None]
+        im = im - mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None, rng=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean, rng=rng)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pre-batch raw images from a tar into pickled batch files
+    (reference image.py:48); returns the meta-file path."""
+    import os
+    import pickle
+
+    out_path = f"{data_file}_{dataset_name}_batch"
+    meta = os.path.join(out_path, "batch_meta")
+    if os.path.exists(meta):
+        return meta
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, names, batch_id = [], [], [], 0
+
+    def flush():
+        nonlocal data, labels, batch_id
+        if not data:
+            return
+        p = os.path.join(out_path, f"batch_{batch_id:05d}")
+        with open(p, "wb") as f:
+            pickle.dump({"data": data, "label": labels}, f)
+        names.append(p)
+        data, labels = [], []
+        batch_id += 1
+
+    with tarfile.open(data_file) as tf:
+        for member in tf.getmembers():
+            if member.name not in img2label:
+                continue
+            data.append(tf.extractfile(member).read())
+            labels.append(img2label[member.name])
+            if len(data) == num_per_batch:
+                flush()
+    flush()
+    with open(meta, "w") as f:
+        f.write("\n".join(names))
+    return meta

@@ -319,3 +319,31 @@ def test_edit_distance_evaluator():
     # distances: 0, edit([1,1,0,0],[2,2])=4, 1 -> avg 5/3; errors 2/3
     assert abs(avg_dist - 5.0 / 3.0) < 1e-5
     assert abs(err_rate - 2.0 / 3.0) < 1e-9
+
+
+def test_image_transforms():
+    """v2 image.py surface: resize_short, crops, flip, simple_transform."""
+    from paddle_tpu import image
+
+    rng = np.random.RandomState(0)
+    im = rng.randint(0, 255, (60, 80, 3)).astype(np.uint8)
+    r = image.resize_short(im, 30)
+    assert r.shape == (30, 40, 3)  # aspect preserved, short edge 30
+    c = image.center_crop(r, 24)
+    assert c.shape == (24, 24, 3)
+    rc = image.random_crop(r, 24, rng=np.random.RandomState(1))
+    assert rc.shape == (24, 24, 3)
+    f = image.left_right_flip(c)
+    assert (f == c[:, ::-1]).all()
+    out = image.simple_transform(im, 32, 28, is_train=True,
+                                 mean=[1.0, 2.0, 3.0],
+                                 rng=np.random.RandomState(2))
+    assert out.shape == (3, 28, 28) and out.dtype == np.float32
+    # eval path is deterministic
+    a = image.simple_transform(im, 32, 28, is_train=False)
+    b = image.simple_transform(im, 32, 28, is_train=False)
+    assert (a == b).all()
+    # bilinear resize interpolates: a 2x2 checker upsampled has midtones
+    small = np.array([[0.0, 100.0], [100.0, 0.0]], np.float32)[..., None]
+    big = image.resize_short(np.repeat(small, 3, axis=2), 4)
+    assert 20 < float(big[1, 1].mean()) < 80
