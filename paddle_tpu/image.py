@@ -92,18 +92,32 @@ def to_chw(im, order=(2, 0, 1)):
     return im.transpose(order)
 
 
-def center_crop(im, size, is_color=True):
+def _check_crop(im, size):
     h, w = im.shape[:2]
-    h0 = max((h - size) // 2, 0)
-    w0 = max((w - size) // 2, 0)
+    if size > h or size > w:
+        raise ValueError(f"crop size {size} exceeds image {h}x{w}")
+
+
+def _randint(rng, lo, hi):
+    # accept both legacy RandomState (randint) and Generator (integers)
+    fn = getattr(rng, "integers", None) or rng.randint
+    return int(fn(lo, hi))
+
+
+def center_crop(im, size, is_color=True):
+    _check_crop(im, size)
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
     return im[h0:h0 + size, w0:w0 + size]
 
 
 def random_crop(im, size, is_color=True, rng=None):
-    rng = rng or np.random
+    _check_crop(im, size)
+    rng = rng if rng is not None else np.random
     h, w = im.shape[:2]
-    h0 = rng.randint(0, max(h - size, 0) + 1)
-    w0 = rng.randint(0, max(w - size, 0) + 1)
+    h0 = _randint(rng, 0, h - size + 1)
+    w0 = _randint(rng, 0, w - size + 1)
     return im[h0:h0 + size, w0:w0 + size]
 
 
@@ -115,11 +129,11 @@ def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
                      mean=None, rng=None):
     """Resize-short -> (random crop + maybe-flip | center crop) -> CHW
     float32 -> optional mean subtract (reference image.py:291)."""
-    rng = rng or np.random
+    rng = rng if rng is not None else np.random
     im = resize_short(im, resize_size)
     if is_train:
         im = random_crop(im, crop_size, is_color=is_color, rng=rng)
-        if rng.randint(2) == 0:
+        if _randint(rng, 0, 2) == 0:
             im = left_right_flip(im, is_color)
     else:
         im = center_crop(im, crop_size, is_color=is_color)
@@ -147,7 +161,7 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
     import os
     import pickle
 
-    out_path = f"{data_file}_{dataset_name}_batch"
+    out_path = os.path.abspath(f"{data_file}_{dataset_name}_batch")
     meta = os.path.join(out_path, "batch_meta")
     if os.path.exists(meta):
         return meta

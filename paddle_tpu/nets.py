@@ -7,10 +7,15 @@ from . import layers
 __all__ = [
     "simple_img_conv_pool",
     "img_conv_group",
+    "img_conv_bn_pool",
+    "img_separable_conv",
     "sequence_conv_pool",
     "glu",
     "scaled_dot_product_attention",
+    "dot_product_attention",
     "simple_attention",
+    "bidirectional_lstm",
+    "bidirectional_gru",
 ]
 
 
@@ -136,3 +141,66 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
             scaled.name + "@LENGTH", encoded_sequence.length_var()
         )
     return layers.sequence_pool(scaled, pool_type="sum")
+
+
+def img_conv_bn_pool(input, num_filters, filter_size, pool_size, pool_stride,
+                     act="relu", conv_padding=0, pool_type="max",
+                     is_test=False, param_attr=None):
+    """conv -> batch_norm(act) -> pool (reference v2 networks.py
+    img_conv_bn_pool)."""
+    conv = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        padding=conv_padding, param_attr=param_attr, act=None,
+        bias_attr=False,
+    )
+    bn = layers.batch_norm(conv, act=act, is_test=is_test)
+    return layers.pool2d(bn, pool_size=pool_size, pool_stride=pool_stride,
+                         pool_type=pool_type)
+
+
+def img_separable_conv(input, num_channels, num_out_channels, filter_size,
+                       stride=1, padding=0, act=None, bias_attr=None):
+    """Depthwise + pointwise separable conv (reference v2 networks.py
+    img_separable_conv)."""
+    depthwise = layers.conv2d(
+        input=input, num_filters=num_channels, filter_size=filter_size,
+        stride=stride, padding=padding, groups=num_channels,
+        act=None, bias_attr=bias_attr,
+    )
+    return layers.conv2d(
+        input=depthwise, num_filters=num_out_channels, filter_size=1,
+        act=act, bias_attr=bias_attr,
+    )
+
+
+def bidirectional_lstm(input, size, return_concat=True):
+    """Forward + backward dynamic LSTM over a padded sequence batch
+    (reference v2 networks.py bidirectional_lstm).  input [b, t, 4*size]
+    pre-projected; returns [b, t, 2*size] concat (or the pair)."""
+    fwd, _ = layers.dynamic_lstm(input, size=size * 4, is_reverse=False)
+    bwd, _ = layers.dynamic_lstm(input, size=size * 4, is_reverse=True)
+    if not return_concat:
+        return fwd, bwd
+    out = layers.concat([fwd, bwd], axis=2)
+    layers.link_sequence(out, input)
+    return out
+
+
+def bidirectional_gru(input, size, return_concat=True):
+    """Forward + backward dynamic GRU; input [b, t, 3*size] pre-projected
+    (reference v2 networks.py bidirectional_gru)."""
+    fwd = layers.dynamic_gru(input, size=size, is_reverse=False)
+    bwd = layers.dynamic_gru(input, size=size, is_reverse=True)
+    if not return_concat:
+        return fwd, bwd
+    out = layers.concat([fwd, bwd], axis=2)
+    layers.link_sequence(out, input)
+    return out
+
+
+def dot_product_attention(queries, keys, values):
+    """Unscaled single-head dot-product attention (reference v2
+    networks.py dot_product_attention): softmax(Q K^T) V."""
+    product = layers.matmul(queries, keys, transpose_y=True)
+    weights = layers.softmax(product)
+    return layers.matmul(weights, values)
