@@ -1,0 +1,55 @@
+"""MNIST LeNet end to end: model zoo + Trainer events + async checkpoints
++ export + reload (the reference book chapter 2 workflow).
+
+    python examples/train_mnist.py [--passes 3]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--out", default="mnist_model")
+    args = ap.parse_args()
+
+    model = pt.models.lenet.build(learning_rate=0.001)
+    feeder = pt.DataFeeder(model["feed"])
+
+    def train_reader():
+        for img, lbl in pt.dataset.mnist.train()():
+            yield img.reshape(1, 28, 28), lbl
+
+    def handler(e):
+        if isinstance(e, pt.trainer.EndIteration) and e.batch_id % 50 == 0:
+            acc = float(np.asarray(e.metrics[0]).ravel()[0])
+            print(f"pass {e.pass_id} batch {e.batch_id} "
+                  f"cost {e.cost:.4f} acc {acc:.3f}")
+
+    tr = pt.trainer.Trainer(model["avg_cost"], model["feed"],
+                            extra_fetch=[model["accuracy"]])
+    tr.train(pt.reader.batch(train_reader, args.batch_size),
+             num_passes=args.passes, event_handler=handler,
+             checkpoint_dir="mnist_ckpts", async_checkpoint=True)
+
+    pt.io.save_inference_model(args.out, ["img"], [model["prediction"]],
+                               tr.exe)
+    engine = pt.inference.InferenceEngine(args.out)
+    sample = list(pt.reader.firstn(train_reader, 4)())
+    probs = engine.run(feed={"img": np.stack([im for im, _ in sample])})
+    pred = np.asarray(probs[0]).argmax(axis=1)
+    print("reloaded model predictions:", pred.tolist(),
+          "labels:", [int(l) for _, l in sample])
+
+
+if __name__ == "__main__":
+    main()

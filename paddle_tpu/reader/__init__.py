@@ -17,11 +17,12 @@ from .decorator import (
     firstn,
     xmap_readers,
     batch,
+    prefetch_to_device,
 )
 from . import creator
 from . import provider
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-    "xmap_readers", "batch", "creator", "provider",
+    "xmap_readers", "batch", "prefetch_to_device", "creator", "provider",
 ]

@@ -347,3 +347,56 @@ def test_image_transforms():
     small = np.array([[0.0, 100.0], [100.0, 0.0]], np.float32)[..., None]
     big = image.resize_short(np.repeat(small, 3, axis=2), 4)
     assert 20 < float(big[1, 1].mean()) < 80
+
+
+def test_prefetch_to_device_reader():
+    """prefetch_to_device yields device-resident feeds ahead of use and
+    propagates producer errors."""
+    import jax
+
+    from paddle_tpu import reader as rdr
+
+    def batches():
+        for i in range(4):
+            yield {"x": np.full((2, 3), i, np.float32)}
+
+    got = list(rdr.prefetch_to_device(batches, size=2)())
+    assert len(got) == 4
+    assert all(isinstance(b["x"], jax.Array) for b in got)
+    np.testing.assert_array_equal(np.asarray(got[3]["x"]), 3.0)
+
+    def exploding():
+        yield {"x": np.zeros(2, np.float32)}
+        raise RuntimeError("producer boom")
+
+    it = rdr.prefetch_to_device(exploding, size=2)()
+    next(it)
+    try:
+        list(it)
+        assert False, "expected producer error to propagate"
+    except RuntimeError as e:
+        assert "boom" in str(e)
+
+
+def test_prefetch_with_data_feeder_trains():
+    import paddle_tpu as pt
+    from paddle_tpu.models import fit_a_line
+
+    outs = fit_a_line.build(learning_rate=0.05)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feeder = pt.DataFeeder(outs["feed"])
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(13, 1)).astype(np.float32)
+
+    def batches():
+        for _ in range(6):
+            x = rng.normal(size=(16, 13)).astype(np.float32)
+            yield [(x[i], (x[i] @ w)) for i in range(16)]
+
+    losses = []
+    for feed in pt.reader.prefetch_to_device(batches, 2, feeder.feed)():
+        (c,) = exe.run(feed=feed, fetch_list=[outs["avg_cost"]])
+        losses.append(float(np.asarray(c).ravel()[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
