@@ -42,7 +42,8 @@ def main():
         cost, acc = exe.run(feed={"img": img, "label": lbl},
                             fetch_list=[model["avg_cost"],
                                         model["accuracy"]])
-        print(f"step {step} cost {float(np.asarray(cost).ravel()[0]):.4f}")
+        print(f"step {step} cost {float(np.asarray(cost).ravel()[0]):.4f} "
+              f"acc {float(np.asarray(acc).ravel()[0]):.3f}")
 
 
 if __name__ == "__main__":

@@ -68,26 +68,42 @@ class Trainer:
 
     def train(self, reader, num_passes=1, event_handler=None,
               checkpoint_dir=None, checkpoint_every_n_passes=1,
-              async_checkpoint=False):
+              async_checkpoint=False, prefetch=0):
         """``async_checkpoint=True`` writes per-pass checkpoints from a
         background thread (io.AsyncCheckpointer): training only pays the
         device->host snapshot, not serialization + disk IO.  Pending
-        writes are drained before train() returns."""
+        writes are drained before train() returns.
+
+        ``prefetch=N`` pads/converts and device-transfers up to N batches
+        ahead on a producer thread (reader.prefetch_to_device), so steps
+        never stall on the input pipe."""
         if not self._initialized:
             self.init_params()
         event_handler = event_handler or (lambda e: None)
         fetch = [self.cost] + list(self.extra_fetch)
+        if prefetch:
+            from .reader import prefetch_to_device
+
+            def batches():
+                return iter(prefetch_to_device(
+                    reader, prefetch, self.feeder.feed)())
+        else:
+            # keep feeder.feed inside the per-batch timer (as before this
+            # path existed): raw batches here, convert in the loop below
+            def batches():
+                return (b for b in reader())
         ckpt = _io.AsyncCheckpointer() if (
             checkpoint_dir and async_checkpoint) else None
         try:
             for pass_id in range(num_passes):
                 event_handler(BeginPass(pass_id))
-                for batch_id, batch in enumerate(reader()):
+                for batch_id, item in enumerate(batches()):
                     event_handler(BeginIteration(pass_id, batch_id))
                     with _profiler.timer("train_batch"):
+                        feed = item if prefetch else self.feeder.feed(item)
                         vals = self.exe.run(
                             self.main_program,
-                            feed=self.feeder.feed(batch),
+                            feed=feed,
                             fetch_list=fetch,
                         )
                     cost = float(np.asarray(vals[0]).reshape(-1)[0])

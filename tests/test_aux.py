@@ -400,3 +400,26 @@ def test_prefetch_with_data_feeder_trains():
         losses.append(float(np.asarray(c).ravel()[0]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_trainer_with_prefetch():
+    import paddle_tpu as pt
+    from paddle_tpu.models import fit_a_line
+
+    outs = fit_a_line.build(learning_rate=0.05)
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(13, 1)).astype(np.float32)
+
+    def reader():
+        for _ in range(5):
+            x = rng.normal(size=(8, 13)).astype(np.float32)
+            yield [(x[i], x[i] @ w) for i in range(8)]
+
+    costs = []
+    tr = pt.trainer.Trainer(outs["avg_cost"], outs["feed"])
+    tr.train(reader, num_passes=2, prefetch=2,
+             event_handler=lambda e: costs.append(e.cost)
+             if isinstance(e, pt.trainer.EndIteration) else None)
+    assert len(costs) == 10
+    assert np.isfinite(costs).all()
+    assert costs[-1] < costs[0]
