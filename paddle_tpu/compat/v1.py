@@ -49,6 +49,8 @@ __all__ = [
     "MomentumOptimizer", "AdamOptimizer", "AdaGradOptimizer",
     "RMSPropOptimizer", "AdaDeltaOptimizer", "settings",
     "L2Regularization",
+    # config bookkeeping
+    "inputs", "outputs",
 ]
 
 
@@ -426,3 +428,16 @@ def _regularizer(reg):
     if isinstance(reg, L2Regularization):
         return reg_mod.L2Decay(reg.rate)
     return reg
+
+
+# ----------------------------------------------------------- bookkeeping
+def inputs(*layers_):
+    """v1 config bookkeeping (declares feed order).  The Program tracks
+    data vars itself; returned list preserved for caller convenience."""
+    return list(layers_)
+
+
+def outputs(*layers_):
+    """v1 config bookkeeping (declares fetch targets).  Returns the list;
+    fetch targets are whatever you pass to Executor.run(fetch_list=...)."""
+    return list(layers_)

@@ -75,3 +75,10 @@ def test_v1_misc_layers():
     assert cv.shape == (2, 16)
     assert np.allclose(simv, 1.0, atol=1e-5)
     assert np.allclose(scv, 3.0)
+
+
+def test_v1_inputs_outputs_bookkeeping():
+    a = v1.data_layer("a", size=4)
+    out = v1.fc_layer(input=a, size=2, act=v1.SoftmaxActivation())
+    assert v1.inputs(a) == [a]
+    assert v1.outputs(out) == [out]
