@@ -68,7 +68,14 @@ def main():
     label = np.random.randint(0, 1000, (batch, 1))
     if mesh is None:
         img = jax.device_put(jnp.asarray(img, dtype=jnp.bfloat16))
-        label = jax.device_put(jnp.asarray(label, dtype=jnp.int64))
+        label = jax.device_put(jnp.asarray(label, dtype=jnp.int32))
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch_sh = NamedSharding(mesh, P("dp"))
+        img = jax.device_put(jnp.asarray(img, dtype=jnp.bfloat16), batch_sh)
+        label = jax.device_put(
+            jnp.asarray(label, dtype=jnp.int32), batch_sh)
     feed = {"img": img, "label": label}
     fetch = [outs["avg_cost"]]
 

@@ -1,0 +1,194 @@
+"""Ceiling probe: minimal hand-written JAX ResNet-50 train step, bs128 bf16.
+
+No framework — establishes what XLA can do on this chip for this model so
+the executor path has a concrete target. Variants:
+  - NCHW vs NHWC layouts
+  - BN stats in f32, normalize in input dtype (same recipe as the framework)
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+USE_DOT_1X1 = False
+
+def conv(x, w, stride, layout):
+    dn = ("NCHW", "OIHW", "NCHW") if layout == "NCHW" else ("NHWC", "HWIO", "NHWC")
+    if USE_DOT_1X1 and stride == 1 and (
+            (layout == "NCHW" and w.shape[2] == w.shape[3] == 1)
+            or (layout == "NHWC" and w.shape[0] == w.shape[1] == 1)):
+        if layout == "NCHW":
+            # x:[N,C,H,W] w:[O,C,1,1] -> y:[N,O,H,W]
+            return jnp.einsum('nchw,oc->nohw', x, w[:, :, 0, 0])
+        else:
+            return jnp.einsum('nhwc,co->nhwo', x, w[0, 0])
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME", dimension_numbers=dn)
+
+
+def bn(x, p, layout):
+    cdim = 1 if layout == "NCHW" else 3
+    axes = tuple(i for i in range(4) if i != cdim)
+    n = np.prod([x.shape[a] for a in axes])
+    mean = jnp.sum(x, axis=axes, dtype=jnp.float32) / n
+    var = jnp.maximum(
+        jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axes) / n
+        - jnp.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + 1e-5)
+    a = p["scale"] * inv
+    b = p["bias"] - mean * a
+    bs = [1, 1, 1, 1]
+    bs[cdim] = x.shape[cdim]
+    return x * a.reshape(bs).astype(x.dtype) + b.reshape(bs).astype(x.dtype)
+
+
+def make_params(key, layout):
+    params = {}
+    idx = [0]
+
+    def add_conv(cin, cout, k):
+        i = idx[0]; idx[0] += 1
+        key_i = jax.random.fold_in(key, i)
+        if layout == "NCHW":
+            shape = (cout, cin, k, k)
+        else:
+            shape = (k, k, cin, cout)
+        params[f"conv{i}"] = (jax.random.normal(key_i, shape, jnp.bfloat16)
+                              * (2.0 / (cin * k * k)) ** 0.5)
+        params[f"bn{i}"] = {"scale": jnp.ones((cout,), jnp.float32),
+                            "bias": jnp.zeros((cout,), jnp.float32)}
+        return i
+
+    cfg = [3, 4, 6, 3]
+    add_conv(3, 64, 7)
+    cin = 64
+    for s, blocks in enumerate(cfg):
+        cmid = 64 * 2 ** s
+        for b in range(blocks):
+            add_conv(cin, cmid, 1)
+            add_conv(cmid, cmid, 3)
+            add_conv(cmid, cmid * 4, 1)
+            if cin != cmid * 4:
+                add_conv(cin, cmid * 4, 1)
+            cin = cmid * 4
+    params["fc_w"] = jax.random.normal(
+        jax.random.fold_in(key, 999), (2048, 1000), jnp.bfloat16) * 0.02
+    params["fc_b"] = jnp.zeros((1000,), jnp.float32)
+    return params
+
+
+def forward(params, x, layout, remat=False, barrier=False):
+    cdim = 1 if layout == "NCHW" else 3
+    idx = [0]
+
+    def cb(x, stride, act=True):
+        i = idx[0]; idx[0] += 1
+        h = conv(x, params[f"conv{i}"], stride, layout)
+        if barrier:
+            h = jax.lax.optimization_barrier(h)
+        h = bn(h, params[f"bn{i}"], layout)
+        return jnp.maximum(h, 0) if act else h
+
+    h = cb(x, 2)
+    window = (1, 1, 3, 3) if layout == "NCHW" else (1, 3, 3, 1)
+    strides = (1, 1, 2, 2) if layout == "NCHW" else (1, 2, 2, 1)
+    h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, window, strides, "SAME")
+    cfg = [3, 4, 6, 3]
+    cin = 64
+    for s, blocks in enumerate(cfg):
+        cmid = 64 * 2 ** s
+        for b in range(blocks):
+            stride = 2 if (b == 0 and s > 0) else 1
+            proj = cin != cmid * 4
+            i0 = idx[0]
+
+            def block(h, _i0=i0, _stride=stride, _proj=proj):
+                idx[0] = _i0
+                h0 = h
+                h1 = cb(h, _stride)
+                h1 = cb(h1, 1)
+                h1 = cb(h1, 1, act=False)
+                if _proj:
+                    h0 = cb(h0, _stride, act=False)
+                return jnp.maximum(h0 + h1, 0)
+
+            if remat:
+                h = jax.checkpoint(block)(h)
+            else:
+                h = block(h)
+            idx[0] = i0 + 3 + (1 if proj else 0)
+            cin = cmid * 4
+    h = jnp.mean(h, axis=(2, 3) if layout == "NCHW" else (1, 2),
+                 dtype=jnp.float32)
+    logits = h.astype(jnp.bfloat16) @ params["fc_w"]
+    return logits.astype(jnp.float32) + params["fc_b"]
+
+
+def loss_fn(params, x, y, layout, remat=False, barrier=False):
+    logits = forward(params, x, layout, remat, barrier)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y, axis=1))
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5), donate_argnums=(0,))
+def train_step(params, x, y, layout, remat=False, barrier=False):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, y, layout, remat, barrier)
+    params = jax.tree.map(lambda p, g: (p - 0.1 * g.astype(p.dtype)), params, grads)
+    return params, loss
+
+
+def run(layout, batch=128, remat=False, barrier=False):
+    params = make_params(jax.random.PRNGKey(0), layout)
+    shape = (batch, 3, 224, 224) if layout == "NCHW" else (batch, 224, 224, 3)
+    x = jax.device_put(jnp.asarray(np.random.rand(*shape), jnp.bfloat16))
+    y = jax.device_put(jnp.asarray(
+        np.random.randint(0, 1000, (batch, 1)), jnp.int32))
+    for _ in range(3):
+        params, loss = train_step(params, x, y, layout, remat, barrier)
+    float(loss)
+    t0 = time.perf_counter()
+    steps = 10
+    for _ in range(steps):
+        params, loss = train_step(params, x, y, layout, remat, barrier)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    # cost analysis
+    try:
+        comp = train_step.lower(params, x, y, layout, remat, barrier).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        extra = (f"  [{ca.get('flops',0)/1e12:.2f} TFLOP, "
+                 f"{ca.get('bytes accessed',0)/1e9:.1f} GB]")
+    except Exception:
+        extra = ""
+    print(f"pure-jax {layout} bs{batch} remat={remat} barrier={barrier}: {dt*1e3:7.2f} ms/step  {batch/dt:8.1f} img/s{extra}")
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices())
+    import sys as _s
+    which = _s.argv[1] if len(_s.argv) > 1 else "all"
+    if which in ("all", "remat"):
+        run("NCHW", 128, remat=True)
+    if which in ("all", "bs256"):
+        run("NCHW", 256)
+    if which in ("all", "bs256r"):
+        run("NCHW", 256, remat=True)
+    if which == "dot1x1":
+        import benchmarks  # noqa
+        globals()['USE_DOT_1X1'] = True
+        run("NCHW", 128)
+        run("NHWC", 128)
+    if which in ("all", "barrier"):
+        run("NCHW", 128, barrier=True)
+    if which in ("all", "barrier_nhwc"):
+        run("NHWC", 128, barrier=True)

@@ -1,0 +1,100 @@
+"""Bisect the ResNet-50 step: where do the 56ms go?
+
+Variants (all bs128, bf16, real chip):
+  full      : train step as benched (BN train-mode, momentum, acc)
+  fwd       : inference forward only
+  nobn_tr   : train step with BN replaced by identity-act (is_test BN)
+  plain_sgd : momentum -> sgd
+Also prints XLA cost_analysis (flops, bytes) for the full step.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _sync(out):
+    leaves = jax.tree.leaves(out)
+    return float(jnp.sum(leaves[-1].astype(jnp.float32).ravel()[0]))
+
+
+def time_step(jstep, state, args, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = jstep(state, *args)
+    _sync(out)
+    t0 = time.perf_counter()
+    s = state
+    for _ in range(steps):
+        s, f = jstep(s, *args)
+    _sync(f)
+    return (time.perf_counter() - t0) / steps
+
+
+def build_and_time(label, batch=128, is_test=False, use_momentum=True,
+                   cost_analysis=False):
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+    from paddle_tpu import layers, optimizer as opt
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        img = layers.data("img", shape=[3, 224, 224], dtype="bfloat16")
+        label_v = layers.data("label", shape=[1], dtype="int64")
+        prediction = resnet.resnet_imagenet(img, 1000, 50, is_test=is_test)
+        pred32 = layers.cast(prediction, "float32")
+        cost = layers.cross_entropy(input=pred32, label=label_v)
+        avg_cost = layers.mean(cost)
+        if not is_test:
+            if use_momentum:
+                opt.Momentum(learning_rate=0.1, momentum=0.9).minimize(avg_cost)
+            else:
+                opt.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    scope = pt.Scope()
+    pt.core.scope._scope_stack.append(scope)
+    try:
+        exe = pt.Executor(donate_state=False)
+        exe.run(startup, scope=scope)
+        scope.ensure_rng(main_prog.random_seed)
+        state_names = tuple(sorted(
+            v.name for v in main_prog.persistable_vars()
+            if scope.find_var(v.name) is not None))
+        step, _ = exe.lower(main_prog, ["img", "label"],
+                            [avg_cost.name], state_names)
+        jstep = jax.jit(step)
+        state = {n: scope.get(n) for n in state_names}
+        state[pt.core.scope.RNG_VAR] = scope.get(pt.core.scope.RNG_VAR)
+        imgs = jax.device_put(jnp.asarray(
+            np.random.rand(batch, 3, 224, 224), dtype=jnp.bfloat16))
+        labels = jax.device_put(jnp.asarray(
+            np.random.randint(0, 1000, (batch, 1)), dtype=jnp.int32))
+        if cost_analysis:
+            lowered = jstep.lower(state, imgs, labels)
+            comp = lowered.compile()
+            try:
+                ca = comp.cost_analysis()
+                if isinstance(ca, list):
+                    ca = ca[0]
+                print(f"  cost_analysis[{label}]: "
+                      f"flops={ca.get('flops', 0)/1e12:.3f} TFLOP "
+                      f"bytes={ca.get('bytes accessed', 0)/1e9:.3f} GB")
+            except Exception as e:
+                print("  cost_analysis unavailable:", e)
+        dt = time_step(jstep, state, (imgs, labels))
+        print(f"{label:12s}: {dt*1e3:8.2f} ms/step  {batch/dt:8.1f} img/s")
+        return dt
+    finally:
+        pt.core.scope._scope_stack.pop()
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices())
+    build_and_time("full", is_test=False, cost_analysis=True)
+    build_and_time("fwd", is_test=True, cost_analysis=True)
+    build_and_time("plain_sgd", is_test=False, use_momentum=False)

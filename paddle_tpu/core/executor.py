@@ -373,6 +373,20 @@ class Executor:
 
                 segments = getattr(program, "_remat_segments", None)
 
+                # Only the values actually consumed after the backward
+                # split may escape the differentiated forward as aux:
+                # optimizer-op inputs, fetches, and persistables (BN
+                # stats, metric accumulators).  Returning the whole env
+                # would make every intermediate activation a computation
+                # OUTPUT, forcing XLA to materialize all of them to HBM
+                # (measured: 53 GB accessed/step on ResNet-50 bs128 vs
+                # ~16 GB with the trimmed aux) and blocking fusion.
+                aux_names = set(fetch_names) | set(persist_out)
+                aux_names.add(info["loss"])
+                for op_ in block.ops[bw:]:
+                    for slot_names in op_.inputs.values():
+                        aux_names.update(slot_names)
+
                 def fwd(tparams, env0):
                     e = dict(env0)
                     e.update(tparams)
@@ -410,10 +424,12 @@ class Executor:
                             outs = jax.checkpoint(seg_fn)(e)
                             e.update(outs)
                     loss = e[info["loss"]]
-                    return jnp.sum(loss), e
+                    aux = {n: e[n] for n in aux_names if n in e}
+                    return jnp.sum(loss), aux
 
                 tparams = {n: env[n] for n in param_names}
-                grads, env = jax.grad(fwd, has_aux=True)(tparams, env)
+                grads, aux = jax.grad(fwd, has_aux=True)(tparams, env)
+                env.update(aux)
                 for n, g in grads.items():
                     env[n + GRAD_SUFFIX] = g
                 run_block_ops(ctx, block, block.ops[bw:], env)

@@ -8,6 +8,8 @@ whole im2col/cuDNN-algorithm-selection machinery disappears.  Layout stays
 NCHW at the API (reference convention); XLA relayouts internally as needed.
 """
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -252,6 +254,69 @@ def spp(X, pyramid_height=3, pooling_type="max", **_):
     return {"Out": jnp.concatenate(outs, axis=1)}
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(X, Scale, Bias, axes, epsilon):
+    y, _ = _bn_train_fwd(X, Scale, Bias, axes, epsilon)
+    return y
+
+
+def _bn_stats(X, axes):
+    """Per-channel mean/var with f32 accumulation; full-tensor reads stay
+    in X.dtype (the reductions fuse over the bf16 tensor — no f32 copy).
+    Centered two-pass form: E[x^2]-E[x]^2 cancels catastrophically in f32
+    for large-mean channels (e.g. raw-pixel-scale inputs)."""
+    n = 1
+    for a in axes:
+        n *= X.shape[a]
+    mean = jnp.sum(X, axis=axes, dtype=jnp.float32) / n
+    bs = _bshape(X, axes)
+    centered = X.astype(jnp.float32) - mean.reshape(bs)
+    var = jnp.sum(jnp.square(centered), axis=axes) / n
+    return mean, var
+
+
+def _bshape(X, axes):
+    return [1 if i in axes else X.shape[i] for i in range(X.ndim)]
+
+
+def _bn_train_fwd(X, Scale, Bias, axes, epsilon):
+    # Per-channel coefficients in f32 (tiny); the full-tensor normalize is
+    # ONE fused multiply-add in X.dtype.  Computing the full-tensor math in
+    # f32 instead doubles HBM traffic on bf16 models (measured: the f32
+    # variant put ResNet-50 bs128 at 53 GB accessed/step vs ~20 GB).
+    mean, var = _bn_stats(X, axes)
+    inv = jax.lax.rsqrt(var + epsilon)
+    a = Scale.astype(jnp.float32) * inv
+    b = Bias.astype(jnp.float32) - mean * a
+    bs = _bshape(X, axes)
+    y = X * a.reshape(bs).astype(X.dtype) + b.reshape(bs).astype(X.dtype)
+    return y, (X, Scale, mean, inv)
+
+
+def _bn_train_bwd(axes, epsilon, res, dY):
+    # Textbook BN backward: f32 per-channel reductions, X.dtype elementwise.
+    X, Scale, mean, inv = res
+    bs = _bshape(X, axes)
+    n = 1
+    for a in axes:
+        n *= X.shape[a]
+    mean_c = mean.reshape(bs).astype(X.dtype)
+    inv_c = inv.reshape(bs).astype(X.dtype)
+    xhat = (X - mean_c) * inv_c
+    sum_dy = jnp.sum(dY, axis=axes, dtype=jnp.float32)
+    sum_dy_xhat = jnp.sum((dY * xhat).astype(jnp.float32), axis=axes)
+    coef = (Scale.astype(jnp.float32) * inv).reshape(bs)
+    dX = coef.astype(X.dtype) * (
+        dY
+        - (sum_dy / n).reshape(bs).astype(X.dtype)
+        - xhat * (sum_dy_xhat / n).reshape(bs).astype(X.dtype)
+    )
+    return dX, sum_dy_xhat.astype(Scale.dtype), sum_dy.astype(Scale.dtype)
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 @register_op("batch_norm")
 def batch_norm(
     X,
@@ -266,31 +331,35 @@ def batch_norm(
     **_,
 ):
     axes = tuple(i for i in range(X.ndim) if i != (1 if data_layout == "NCHW" else X.ndim - 1))
-    cdim = 1 if data_layout == "NCHW" else X.ndim - 1
-    bshape = [1] * X.ndim
-    bshape[cdim] = X.shape[cdim]
+    bshape = _bshape(X, axes)
 
-    xf = X.astype(jnp.float32)
     if is_test:
         mean, var = Mean.astype(jnp.float32), Variance.astype(jnp.float32)
-        mean_out, var_out = Mean, Variance
-        saved_mean, saved_var = Mean, Variance
-    else:
-        mean = jnp.mean(xf, axis=axes)
-        # centered form: E[x^2]-E[x]^2 can cancel to a negative in f32
-        var = jnp.mean(jnp.square(xf - mean.reshape(bshape)), axis=axes)
-        mean_out = (momentum * Mean.astype(jnp.float32) + (1 - momentum) * mean).astype(Mean.dtype)
-        var_out = (momentum * Variance.astype(jnp.float32) + (1 - momentum) * var).astype(Variance.dtype)
-        saved_mean, saved_var = mean, var
-    inv = jax.lax.rsqrt(var + epsilon)
-    y = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
-    y = y * Scale.astype(jnp.float32).reshape(bshape) + Bias.astype(jnp.float32).reshape(bshape)
+        inv = jax.lax.rsqrt(var + epsilon)
+        a = Scale.astype(jnp.float32) * inv
+        b = Bias.astype(jnp.float32) - mean * a
+        y = X * a.reshape(bshape).astype(X.dtype) \
+            + b.reshape(bshape).astype(X.dtype)
+        return {
+            "Y": y,
+            "MeanOut": Mean,
+            "VarianceOut": Variance,
+            "SavedMean": Mean,
+            "SavedVariance": Variance,
+        }
+
+    mean, var = _bn_stats(X, axes)
+    mean_out = (momentum * Mean.astype(jnp.float32) + (1 - momentum) * mean).astype(Mean.dtype)
+    var_out = (momentum * Variance.astype(jnp.float32) + (1 - momentum) * var).astype(Variance.dtype)
+    # Stats are training bookkeeping, not part of the differentiated graph
+    # (the reference's batch_norm_op.cc likewise treats them as buffers).
+    y = _bn_train(X, Scale, Bias, axes, epsilon)
     return {
-        "Y": y.astype(X.dtype),
+        "Y": y,
         "MeanOut": mean_out,
         "VarianceOut": var_out,
-        "SavedMean": saved_mean,
-        "SavedVariance": saved_var,
+        "SavedMean": jax.lax.stop_gradient(mean),
+        "SavedVariance": jax.lax.stop_gradient(var),
     }
 
 
