@@ -365,10 +365,9 @@ def nce_layer(input, label, num_classes, num_neg_samples=10, **_):
 
 
 def hsigmoid(input, label, num_classes, **_):
-    # hierarchical sigmoid approximated by NCE here (same role: cheap
-    # large-vocab classification); exact tree-sigmoid not carried.
-    return layers.nce(input=input, label=label,
-                      num_total_classes=num_classes)
+    # exact tree sigmoid (reference HierarchicalSigmoidLayer.cpp)
+    return layers.hsigmoid(input=input, label=label,
+                           num_classes=num_classes)
 
 
 # --------------------------------------------------------------- optimizers

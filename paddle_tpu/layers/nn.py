@@ -57,6 +57,8 @@ __all__ = [
     "l2_normalize",
     "im2sequence",
     "nce",
+    "hsigmoid",
+    "selective_fc",
     "row_conv",
     "multiplex",
     "linear_chain_crf",
@@ -711,7 +713,8 @@ def auc(input, label, curve="ROC", num_thresholds=200):
     return out
 
 
-def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=1):
+def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=1,
+               excluded_chunk_types=None):
     helper = LayerHelper("chunk_eval")
     outs = {
         n: helper.create_tmp_variable(
@@ -728,7 +731,8 @@ def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=1):
         type="chunk_eval",
         inputs=inputs,
         outputs={k: [v.name] for k, v in outs.items()},
-        attrs={"chunk_scheme": chunk_scheme, "num_chunk_types": num_chunk_types},
+        attrs={"chunk_scheme": chunk_scheme, "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": tuple(excluded_chunk_types or ())},
     )
     return (
         outs["Precision"], outs["Recall"], outs["F1-Score"],
@@ -1125,6 +1129,68 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
         attrs={"kernels": list(k), "strides": list(s), "paddings": list(p)},
     )
     return out
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid over a complete binary tree — large-vocab
+    classification at O(log C) cost (reference
+    ``paddle/gserver/layers/HierarchicalSigmoidLayer.cpp:1``, config
+    helper ``hsigmoid`` in trainer_config_helpers/layers.py)."""
+    helper = LayerHelper("hsigmoid", name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(
+        param_attr, shape=[num_classes - 1, dim], dtype=input.dtype)
+    inputs = {"X": [input.name], "W": [w.name], "Label": [label.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            ParamAttr.to_attr(bias_attr) or ParamAttr(),
+            shape=[num_classes - 1], dtype=input.dtype, suffix="b",
+            default_initializer=init_mod.Constant(0.0),
+        )
+        inputs["Bias"] = [b.name]
+    max_len = max(1, (2 * num_classes - 1).bit_length() - 1)
+    cost = helper.create_tmp_variable(input.dtype, [input.shape[0], 1])
+    pre_out = helper.create_tmp_variable(
+        input.dtype, [input.shape[0], max_len], stop_gradient=True)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs=inputs,
+        outputs={"Out": [cost.name], "PreOut": [pre_out.name]},
+        attrs={"num_classes": num_classes},
+    )
+    return cost
+
+
+def selective_fc(input, size, select=None, param_attr=None, bias_attr=None,
+                 act=None, name=None):
+    """Fully-connected layer that evaluates only the selected output
+    columns per sample (reference
+    ``paddle/gserver/layers/SelectiveFcLayer.cpp:1``; weight stored one
+    row per output neuron, as there).  ``select`` is an int tensor
+    [batch, s] of column ids (entries < 0 are padding); omit it for a
+    plain full fc pass."""
+    helper = LayerHelper("selective_fc", bias_attr=bias_attr, act=act,
+                         name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(param_attr, shape=[size, dim],
+                                dtype=input.dtype)
+    inputs = {"X": [input.name], "W": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[size],
+            dtype=input.dtype, suffix="b",
+            default_initializer=init_mod.Constant(0.0),
+        )
+        inputs["Bias"] = [b.name]
+    out_cols = select.shape[1] if select is not None else size
+    if select is not None:
+        inputs["Select"] = [select.name]
+    out = helper.create_tmp_variable(input.dtype, [input.shape[0], out_cols])
+    helper.append_op(
+        type="selective_fc", inputs=inputs, outputs={"Out": [out.name]},
+    )
+    return helper.append_activation(out)
 
 
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,

@@ -133,3 +133,44 @@ def nce(Input, Label, Weight, Bias=None, SampleWeight=None,
     return {"Cost": loss[:, None],
             "SampleLogits": jnp.concatenate([pos_logit[:, None], neg_logit], axis=1),
             "SampleLabels": jnp.concatenate([lbl[:, None], neg], axis=1)}
+
+
+@register_op("hierarchical_sigmoid")
+def hierarchical_sigmoid(X, W, Label, Bias=None, num_classes=2, **_):
+    """Hierarchical sigmoid (tree softmax) over a complete binary tree —
+    the large-vocab training capability of the reference's
+    ``paddle/gserver/layers/HierarchicalSigmoidLayer.cpp:1`` (bit-code
+    matrix ops in ``paddle/math/MatrixBitCode.cpp``).
+
+    Bit-code convention (matches the reference's SimpleCode): for class c,
+    ``code = c + num_classes``; path node d has row index
+    ``(code >> (d+1)) - 1`` in ``W`` and target bit ``(code >> d) & 1``;
+    the path length is ``floor(log2(code))``.  Cost per sample is
+    ``sum_d softplus(pre_d) - bit_d * pre_d`` (softrelu-clipped like the
+    reference), i.e. the exact NLL of the label's leaf.
+
+    X [b,d]; W [num_classes-1, d]; Label [b,1]; Bias [num_classes-1].
+    Returns Out [b,1] and PreOut [b, max_code_len].
+    """
+    b = X.shape[0]
+    lbl = _squeeze_label(Label).astype(jnp.int32)
+    code = lbl + num_classes
+    max_len = max(1, (2 * num_classes - 1).bit_length() - 1)
+    d_range = jnp.arange(max_len)
+    # [b, max_len]
+    shifted = code[:, None] >> (d_range[None, :] + 1)
+    active = shifted > 0
+    idx = jnp.maximum(shifted - 1, 0)
+    bits = ((code[:, None] >> d_range[None, :]) & 1).astype(X.dtype)
+    rows = W[idx]  # [b, max_len, d]
+    pre = jnp.einsum("bld,bd->bl", rows, X)
+    if Bias is not None:
+        pre = pre + Bias.reshape(-1)[idx]
+    # reference softrelu threshold 40: clip the VALUE but keep the
+    # reference backward (sigmoid(clip(pre)) - bit), which is nonzero at
+    # saturation — a plain clip would zero the gradient and strand
+    # badly-wrong samples.
+    pre = pre + jax.lax.stop_gradient(jnp.clip(pre, -40.0, 40.0) - pre)
+    loss_terms = jnp.where(active, jax.nn.softplus(pre) - bits * pre, 0.0)
+    out = jnp.sum(loss_terms, axis=1, keepdims=True)
+    return {"Out": out, "PreOut": jnp.where(active, pre, 0.0)}

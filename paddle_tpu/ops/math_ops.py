@@ -240,3 +240,29 @@ def error_clip(X, max=1.0, min=None, **_):
     # cotangent exactly where the reference's backward rewrite clipped).
     lo = -abs(float(max)) if min is None else float(min)
     return {"Out": _identity_clip_grad(X, lo, float(max))}
+
+
+@register_op("selective_fc")
+def selective_fc(X, W, Bias=None, Select=None, **_):
+    """Selective fully-connected: compute only the selected output columns
+    per sample — the reference's large-output-layer capability
+    (``paddle/gserver/layers/SelectiveFcLayer.cpp:1``; weight stored
+    transposed there too, one row per output neuron).
+
+    X [b,d]; W [k,d] (row-major by output neuron); Bias [k];
+    Select [b,s] int ids, entries < 0 are padding.  With Select, Out is
+    [b,s] (padded positions 0); without, a plain full fc Out [b,k].
+    """
+    if Select is None:
+        out = X @ W.T
+        if Bias is not None:
+            out = out + Bias.reshape(1, -1)
+        return {"Out": out}
+    sel = Select.astype(jnp.int32)
+    valid = sel >= 0
+    idx = jnp.maximum(sel, 0)
+    rows = W[idx]  # [b, s, d]
+    out = jnp.einsum("bsd,bd->bs", rows, X)
+    if Bias is not None:
+        out = out + Bias.reshape(-1)[idx]
+    return {"Out": jnp.where(valid, out, 0.0)}

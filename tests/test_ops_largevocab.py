@@ -1,0 +1,156 @@
+"""Large-vocab ops: hierarchical_sigmoid (tree softmax) and selective_fc
+(reference paddle/gserver/layers/HierarchicalSigmoidLayer.cpp,
+SelectiveFcLayer.cpp; no fluid op existed for either in v0.11 — these carry
+the gserver capability)."""
+
+import numpy as np
+
+from op_test import check_output, check_grad, run_op
+
+rng = np.random.RandomState(11)
+
+
+def _hsig_ref(x, w, b, labels, num_classes):
+    """Naive per-sample bit-code walk (SimpleCode convention)."""
+    out = np.zeros((x.shape[0], 1), np.float64)
+    for n, c in enumerate(labels.ravel()):
+        code = int(c) + num_classes
+        length = code.bit_length() - 1
+        for d in range(length):
+            i = (code >> (d + 1)) - 1
+            bit = (code >> d) & 1
+            pre = float(x[n] @ w[i] + (b[i] if b is not None else 0.0))
+            pre = min(max(pre, -40.0), 40.0)
+            out[n, 0] += np.log1p(np.exp(pre)) - bit * pre
+    return out.astype(np.float32)
+
+
+def test_hierarchical_sigmoid_vs_naive_tree_walk():
+    num_classes, d, bsz = 13, 6, 5
+    x = rng.randn(bsz, d).astype(np.float32)
+    w = rng.randn(num_classes - 1, d).astype(np.float32)
+    b = rng.randn(num_classes - 1).astype(np.float32)
+    lbl = rng.randint(0, num_classes, (bsz, 1)).astype(np.int32)
+    expected = _hsig_ref(x, w, b, lbl, num_classes)
+    check_output(
+        "hierarchical_sigmoid",
+        {"X": x, "W": w, "Label": lbl, "Bias": b},
+        {"Out": expected},
+        attrs={"num_classes": num_classes},
+        atol=1e-4, rtol=1e-4,
+    )
+
+
+def test_hierarchical_sigmoid_no_bias_and_pow2_classes():
+    num_classes, d, bsz = 8, 4, 3
+    x = rng.randn(bsz, d).astype(np.float32)
+    w = rng.randn(num_classes - 1, d).astype(np.float32)
+    lbl = np.array([[0], [7], [3]], np.int32)
+    expected = _hsig_ref(x, w, None, lbl, num_classes)
+    got = run_op("hierarchical_sigmoid", {"X": x, "W": w, "Label": lbl},
+                 {"num_classes": num_classes})
+    np.testing.assert_allclose(got["Out"], expected, atol=1e-4, rtol=1e-4)
+    # PreOut is zero at padded (inactive) path positions
+    assert got["PreOut"].shape == (bsz, 3)
+
+
+def test_hierarchical_sigmoid_grad():
+    num_classes, d, bsz = 6, 4, 3
+    inputs = {
+        "X": rng.randn(bsz, d).astype(np.float32),
+        "W": rng.randn(num_classes - 1, d).astype(np.float32) * 0.5,
+        "Label": rng.randint(0, num_classes, (bsz, 1)).astype(np.int32),
+        "Bias": rng.randn(num_classes - 1).astype(np.float32) * 0.1,
+    }
+    attrs = {"num_classes": num_classes}
+    for wrt in ("X", "W", "Bias"):
+        check_grad("hierarchical_sigmoid", inputs, wrt, attrs=attrs,
+                   output="Out", max_relative_error=5e-3)
+
+
+def test_selective_fc_selected_columns():
+    d, k = 5, 9
+    x = rng.randn(3, d).astype(np.float32)
+    w = rng.randn(k, d).astype(np.float32)
+    b = rng.randn(k).astype(np.float32)
+    sel = np.array([[0, 4, -1], [8, 2, 1], [3, -1, -1]], np.int32)
+    expected = np.zeros((3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            if sel[i, j] >= 0:
+                expected[i, j] = x[i] @ w[sel[i, j]] + b[sel[i, j]]
+    check_output("selective_fc", {"X": x, "W": w, "Bias": b, "Select": sel},
+                 {"Out": expected}, atol=1e-4, rtol=1e-4)
+
+
+def test_selective_fc_full_mode_is_fc():
+    d, k = 5, 7
+    x = rng.randn(4, d).astype(np.float32)
+    w = rng.randn(k, d).astype(np.float32)
+    b = rng.randn(k).astype(np.float32)
+    check_output("selective_fc", {"X": x, "W": w, "Bias": b},
+                 {"Out": x @ w.T + b}, atol=1e-4, rtol=1e-4)
+
+
+def test_selective_fc_grad():
+    d, k = 4, 6
+    inputs = {
+        "X": rng.randn(2, d).astype(np.float32),
+        "W": rng.randn(k, d).astype(np.float32),
+        "Bias": rng.randn(k).astype(np.float32),
+        "Select": np.array([[0, 3], [5, -1]], np.int32),
+    }
+    for wrt in ("X", "W", "Bias"):
+        check_grad("selective_fc", inputs, wrt, output="Out",
+                   max_relative_error=5e-3)
+
+
+def test_hsigmoid_layer_trains():
+    """End-to-end: the hsigmoid layer's loss decreases under SGD and the
+    selective_fc layer composes in a program."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        feat = layers.fc(x, size=16, act="tanh")
+        cost = layers.hsigmoid(feat, label, num_classes=10)
+        avg = layers.mean(cost)
+        pt.optimizer.SGD(learning_rate=0.5).minimize(avg)
+
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = rng.randint(0, 10, (32, 1)).astype(np.int64)
+    losses = []
+    for _ in range(30):
+        (l,) = exe.run(main, feed={"x": xs, "label": ys},
+                       fetch_list=[avg], scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_selective_fc_layer_shapes():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        sel = layers.data("sel", shape=[4], dtype="int64")
+        out_sel = layers.selective_fc(x, size=50, select=sel)
+        out_full = layers.selective_fc(x, size=50)
+    assert tuple(out_sel.shape) == (-1, 4) or out_sel.shape[1] == 4
+    assert out_full.shape[1] == 50
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    o1, o2 = exe.run(
+        main,
+        feed={"x": rng.randn(3, 8).astype(np.float32),
+              "sel": rng.randint(0, 50, (3, 4)).astype(np.int64)},
+        fetch_list=[out_sel, out_full], scope=scope)
+    assert o1.shape == (3, 4) and o2.shape == (3, 50)
