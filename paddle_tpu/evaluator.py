@@ -198,22 +198,27 @@ class DetectionMAP:
                  evaluate_difficult=False):
         if ap_version not in ("integral", "11point"):
             raise ValueError(f"unknown ap_version {ap_version!r}")
-        if evaluate_difficult:
-            raise NotImplementedError(
-                "difficult-box filtering is not implemented; update() takes "
-                "no difficult flags — pre-filter difficult GT boxes instead")
         self.overlap_threshold = overlap_threshold
         self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
         self.reset()
 
     def reset(self, executor=None):
-        self._images = []  # (dets, gt_boxes, gt_labels) per image
+        self._images = []  # (dets, gt_boxes, gt_labels, gt_difficult)
 
-    def update(self, detections, gt_boxes, gt_labels):
+    def update(self, detections, gt_boxes, gt_labels, gt_difficult=None):
+        """gt_difficult: optional [g] bools — VOC "difficult" flags.  With
+        evaluate_difficult=False (reference default,
+        DetectionMAPEvaluator.cpp:106-116,184-198) difficult GT count
+        neither toward the positives nor as matches: a detection whose
+        best-overlap GT is difficult is skipped (neither tp nor fp)."""
+        gl = np.asarray(gt_labels).reshape(-1).astype(int)
         self._images.append((
             np.asarray(detections, np.float64).reshape(-1, 6),
             np.asarray(gt_boxes, np.float64).reshape(-1, 4),
-            np.asarray(gt_labels).reshape(-1).astype(int),
+            gl,
+            (np.zeros(len(gl), bool) if gt_difficult is None
+             else np.asarray(gt_difficult).reshape(-1).astype(bool)),
         ))
 
     @staticmethod
@@ -245,34 +250,41 @@ class DetectionMAP:
         return float(np.sum((mr[idx + 1] - mr[idx]) * mp[idx + 1]))
 
     def eval(self, executor=None):
-        classes = sorted({c for _, _, gl in self._images for c in gl})
+        classes = sorted({c for _, _, gl, _ in self._images for c in gl})
         aps = []
         for c in classes:
             records = []  # (score, image_idx, box)
             n_gt = 0
-            for i, (dets, gb, gl) in enumerate(self._images):
-                n_gt += int((gl == c).sum())
+            for i, (dets, gb, gl, gd) in enumerate(self._images):
+                cls = gl == c
+                n_gt += int((cls if self.evaluate_difficult
+                             else np.logical_and(cls, ~gd)).sum())
                 for d in dets[dets[:, 0] == c]:
                     records.append((d[1], i, d[2:6]))
             if n_gt == 0:
                 continue
             records.sort(key=lambda r: -r[0])
             matched = {i: np.zeros(int((gl == c).sum()), bool)
-                       for i, (_, _, gl) in enumerate(self._images)}
+                       for i, (_, _, gl, _) in enumerate(self._images)}
             tp = np.zeros(len(records))
             fp = np.zeros(len(records))
             for k, (_score, i, box) in enumerate(records):
-                _, gb, gl = self._images[i]
+                _, gb, gl, gd = self._images[i]
                 cls_boxes = gb[gl == c]
+                cls_diff = gd[gl == c]
                 if len(cls_boxes) == 0:
                     fp[k] = 1
                     continue
                 ious = self._iou(box, cls_boxes)
                 best = int(np.argmax(ious))
-                if ious[best] >= self.overlap_threshold and \
-                        not matched[i][best]:
-                    tp[k] = 1
-                    matched[i][best] = True
+                if ious[best] >= self.overlap_threshold:
+                    if not self.evaluate_difficult and cls_diff[best]:
+                        continue  # neither tp nor fp (cpp:184-198)
+                    if not matched[i][best]:
+                        tp[k] = 1
+                        matched[i][best] = True
+                    else:
+                        fp[k] = 1
                 else:
                     fp[k] = 1
             aps.append(self._average_precision(tp, fp, n_gt))

@@ -106,73 +106,144 @@ def positive_negative_pair(Score, Label, QueryID, AccumulatePositivePair=None,
     }
 
 
+# chunk_eval scheme tables (chunk_eval_op.h:108-141): per-scheme
+# (num_tag_types, tag_begin, tag_inside, tag_end, tag_single); -1 = unused.
+_CHUNK_SCHEMES = {
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
 @register_op("chunk_eval", nondiff=True)
 def chunk_eval(Inference, Label, Length=None, num_chunk_types=1,
-               chunk_scheme="IOB", **_):
-    """Chunk-level precision/recall/F1 (chunk_eval_op.cc), IOB scheme.
+               chunk_scheme="IOB", excluded_chunk_types=(), **_):
+    """Chunk-level precision/recall/F1 (chunk_eval_op.h:27-198), all four
+    reference schemes (IOB/IOE/IOBES/plain) + excluded_chunk_types.
 
-    Tag encoding follows the reference: for IOB, tag = chunk_type * 2
-    (B-) or chunk_type * 2 + 1 (I-); the "outside" tag is num_chunk_types*2.
-    A chunk match requires identical (begin, end, type) spans.
+    Tag encoding follows the reference: ``tag = label % num_tag_types``,
+    ``type = label // num_tag_types``; the "outside" label is
+    ``num_chunk_types * num_tag_types``.  A chunk match requires an
+    identical (begin, end, type) span.
+
+    The reference walks each sequence with an ``in_chunk`` flag; here the
+    begin/end predicates are evaluated position-wise (exact because a
+    non-outside token is always inside a chunk under every scheme table:
+    ChunkBegin fires whenever prev is outside and cur is not, so ChunkEnd
+    — which needs a non-outside prev — can never fire on a closed chunk).
     """
-    if chunk_scheme != "IOB":
-        raise NotImplementedError("only IOB chunk_scheme is implemented")
+    if chunk_scheme not in _CHUNK_SCHEMES:
+        raise ValueError(f"unknown chunk scheme {chunk_scheme!r}")
+    n_tags, t_beg, t_in, t_end, t_sin = _CHUNK_SCHEMES[chunk_scheme]
+    other = num_chunk_types
     b, t = Inference.shape
     mask = (
-        (jnp.arange(t)[None, :] < Length[:, None])
+        (jnp.arange(t)[None, :] < Length.reshape(-1, 1))
         if Length is not None
         else jnp.ones((b, t), jnp.bool_)
     )
 
-    def spans(tags):
-        """begin[i]: a chunk starts at i; type[i]: its chunk type."""
-        outside = num_chunk_types * 2
-        valid = jnp.logical_and(tags < outside, mask)
-        is_b = jnp.logical_and(valid, tags % 2 == 0)
-        is_i = jnp.logical_and(valid, tags % 2 == 1)
-        ctype = tags // 2
-        prev_valid = jnp.concatenate([jnp.zeros((b, 1), jnp.bool_), valid[:, :-1]], axis=1)
-        prev_type = jnp.concatenate([jnp.full((b, 1), -1, ctype.dtype), ctype[:, :-1]], axis=1)
-        # I- starts a chunk if previous token wasn't inside same-type chunk
-        starts = jnp.logical_or(
-            is_b, jnp.logical_and(is_i, jnp.logical_or(~prev_valid, prev_type != ctype))
-        )
-        nxt_valid = jnp.concatenate([valid[:, 1:], jnp.zeros((b, 1), jnp.bool_)], axis=1)
-        nxt_type = jnp.concatenate([ctype[:, 1:], jnp.full((b, 1), -1, ctype.dtype)], axis=1)
-        nxt_tags = jnp.concatenate([tags[:, 1:], jnp.full((b, 1), outside, tags.dtype)], axis=1)
-        # chunk ends at i if next token is not an I- of same type
-        cont = jnp.logical_and(
-            jnp.logical_and(nxt_valid, nxt_tags % 2 == 1), nxt_type == ctype
-        )
-        ends = jnp.logical_and(valid, ~cont)
-        return starts, ends, ctype, valid
+    def analyze(labels):
+        labels = labels.astype(jnp.int32)
+        tag = labels % n_tags
+        ctype = jnp.where(mask, labels // n_tags, other)
+        # prev at position 0: type = other (chunk_eval_op.h:47 init)
+        prev_tag = jnp.concatenate(
+            [jnp.full((b, 1), -2, tag.dtype), tag[:, :-1]], axis=1)
+        prev_type = jnp.concatenate(
+            [jnp.full((b, 1), other, ctype.dtype), ctype[:, :-1]], axis=1)
 
-    inf_s, inf_e, inf_t, inf_v = spans(Inference.astype(jnp.int32))
-    lab_s, lab_e, lab_t, lab_v = spans(Label.astype(jnp.int32))
+        cur_out = ctype == other
+        prev_out = prev_type == other
+        diff_type = ctype != prev_type
 
-    # identify chunks by their start index; a chunk is (start, end, type).
-    # end index for a chunk starting at i = next end position >= i.
+        # ChunkBegin table (chunk_eval_op.h:93-104)
+        tag_cond = jnp.zeros((b, t), jnp.bool_)
+        if t_beg >= 0:
+            tag_cond = jnp.logical_or(tag_cond, tag == t_beg)
+        if t_sin >= 0:
+            tag_cond = jnp.logical_or(tag_cond, tag == t_sin)
+        prev_closed = jnp.zeros((b, t), jnp.bool_)
+        if t_end >= 0:
+            prev_closed = jnp.logical_or(prev_closed, prev_tag == t_end)
+        if t_sin >= 0:
+            prev_closed = jnp.logical_or(prev_closed, prev_tag == t_sin)
+        if t_in >= 0:
+            tag_cond = jnp.logical_or(
+                tag_cond, jnp.logical_and(tag == t_in, prev_closed))
+        if t_end >= 0:
+            tag_cond = jnp.logical_or(
+                tag_cond, jnp.logical_and(tag == t_end, prev_closed))
+        begins = jnp.where(
+            prev_out, ~cur_out,
+            jnp.where(cur_out, False, jnp.logical_or(diff_type, tag_cond)))
+
+        # ChunkEnd table (chunk_eval_op.h:80-91): a segment ends AT i-1
+        # when this fires at i.
+        end_tag_cond = jnp.zeros((b, t), jnp.bool_)
+        restart = jnp.zeros((b, t), jnp.bool_)  # cur tag begins anew
+        if t_beg >= 0:
+            restart = jnp.logical_or(restart, tag == t_beg)
+        if t_sin >= 0:
+            restart = jnp.logical_or(restart, tag == t_sin)
+        if t_beg >= 0:
+            end_tag_cond = jnp.logical_or(
+                end_tag_cond, jnp.logical_and(prev_tag == t_beg, restart))
+        if t_in >= 0:
+            end_tag_cond = jnp.logical_or(
+                end_tag_cond, jnp.logical_and(prev_tag == t_in, restart))
+        if t_end >= 0:
+            end_tag_cond = jnp.logical_or(end_tag_cond, prev_tag == t_end)
+        if t_sin >= 0:
+            end_tag_cond = jnp.logical_or(end_tag_cond, prev_tag == t_sin)
+        closes = jnp.where(
+            prev_out, False,
+            jnp.where(cur_out, True, jnp.logical_or(diff_type, end_tag_cond)))
+
+        # end_marker[i]: a segment's last token is i — ChunkEnd fires at
+        # i+1, or i is the final (valid) token of a still-open chunk.
+        nxt_closes = jnp.concatenate(
+            [closes[:, 1:], jnp.ones((b, 1), jnp.bool_)], axis=1)
+        end_marker = jnp.logical_and(~cur_out, nxt_closes)
+        return begins, end_marker, ctype
+
+    inf_s, inf_e, inf_t = analyze(Inference)
+    lab_s, lab_e, lab_t = analyze(Label)
+
     idx = jnp.arange(t)[None, :]
 
     def chunk_end(ends):
-        # for each position, the nearest end at or after it
+        # for each position, the nearest segment-final index at/after it
         INF = t + 1
         end_pos = jnp.where(ends, idx, INF)
-        rev_cummin = jnp.flip(jax.lax.cummin(jnp.flip(end_pos, axis=1), axis=1), axis=1)
-        return rev_cummin
+        return jnp.flip(jax.lax.cummin(jnp.flip(end_pos, axis=1), axis=1),
+                        axis=1)
 
     inf_end = chunk_end(inf_e)
     lab_end = chunk_end(lab_e)
-    num_inf = jnp.sum(jnp.where(inf_s, 1.0, 0.0))
-    num_lab = jnp.sum(jnp.where(lab_s, 1.0, 0.0))
+
+    def counted(starts, ctype):
+        ok = starts
+        for ex in excluded_chunk_types:
+            ok = jnp.logical_and(ok, ctype != ex)
+        return ok
+
+    inf_ok = counted(inf_s, inf_t)
+    lab_ok = counted(lab_s, lab_t)
+    num_inf = jnp.sum(jnp.where(inf_ok, 1.0, 0.0))
+    num_lab = jnp.sum(jnp.where(lab_ok, 1.0, 0.0))
     match = jnp.logical_and(
-        jnp.logical_and(inf_s, lab_s),
+        jnp.logical_and(inf_ok, lab_ok),
         jnp.logical_and(inf_end == lab_end, inf_t == lab_t),
     )
     num_correct = jnp.sum(jnp.where(match, 1.0, 0.0))
-    precision = num_correct / jnp.maximum(num_inf, 1e-12)
-    recall = num_correct / jnp.maximum(num_lab, 1e-12)
-    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    # zero-denominator convention of the reference (chunk_eval_op.h:186-197)
+    precision = jnp.where(num_inf > 0, num_correct / jnp.maximum(num_inf, 1.0), 0.0)
+    recall = jnp.where(num_lab > 0, num_correct / jnp.maximum(num_lab, 1.0), 0.0)
+    f1 = jnp.where(
+        num_correct > 0,
+        2 * precision * recall / jnp.maximum(precision + recall, 1e-12), 0.0)
     return {
         "Precision": precision.reshape(1),
         "Recall": recall.reshape(1),

@@ -423,3 +423,69 @@ def test_trainer_with_prefetch():
     assert len(costs) == 10
     assert np.isfinite(costs).all()
     assert costs[-1] < costs[0]
+
+
+def test_auc_evaluator_matches_sklearn_on_random_data():
+    """Host-side Auc (rank-sum) and the in-program auc op vs
+    sklearn.roc_auc_score on random scores (VERDICT r1 item 8)."""
+    sklearn_metrics = pytest.importorskip("sklearn.metrics")
+    roc_auc_score = sklearn_metrics.roc_auc_score
+    from paddle_tpu.evaluator import Auc
+
+    rng = np.random.RandomState(3)
+    for trial in range(5):
+        n = rng.randint(20, 200)
+        scores = rng.rand(n)
+        if trial % 2:  # force ties
+            scores = np.round(scores, 1)
+        labels = rng.randint(0, 2, n)
+        if labels.min() == labels.max():
+            labels[0] = 1 - labels[0]
+        a = Auc()
+        a.update(scores, labels)
+        assert abs(a.eval() - roc_auc_score(labels, scores)) < 1e-9, trial
+
+    # the in-program bucketed auc op approximates sklearn within bucket
+    # resolution
+    from op_test import run_op
+    scores = rng.rand(500).astype(np.float32)
+    labels = rng.randint(0, 2, (500, 1)).astype(np.int64)
+    got = run_op("auc", {"Out": scores.reshape(-1, 1), "Label": labels},
+                 {"num_thresholds": 1000})
+    expected = roc_auc_score(labels.ravel(), scores)
+    assert abs(float(got["AUC"][0]) - expected) < 5e-3
+
+
+def test_detection_map_evaluate_difficult():
+    """Difficult-GT semantics (DetectionMAPEvaluator.cpp:106-116,184-198):
+    with evaluate_difficult=False a difficult GT neither counts as a
+    positive nor marks its matched detection tp/fp; with True it behaves
+    like a normal GT."""
+    from paddle_tpu.evaluator import DetectionMAP
+
+    def build(evaluate_difficult):
+        m = DetectionMAP(overlap_threshold=0.5, ap_version="integral",
+                         evaluate_difficult=evaluate_difficult)
+        # image: GT A (normal) + GT B (difficult); det1 matches B (skip),
+        # det2 matches A (tp), det3 matches nothing (fp)
+        m.update(
+            detections=[[1, 0.9, 100, 100, 110, 110],   # on B
+                        [1, 0.8, 0, 0, 10, 10],          # on A
+                        [1, 0.7, 300, 300, 310, 310]],   # nothing
+            gt_boxes=[[0, 0, 10, 10], [100, 100, 110, 110]],
+            gt_labels=[1, 1],
+            gt_difficult=[False, True],
+        )
+        return m
+
+    # n_gt=1 (B excluded); rank: det1 skipped, det2 tp (P=1,R=1), det3 fp.
+    # integral AP = 1.0
+    assert abs(build(False).eval() - 1.0) < 1e-9
+    # n_gt=2; det1 tp (P=1, R=0.5), det2 tp (P=1, R=1), det3 fp -> AP 1.0
+    assert abs(build(True).eval() - 1.0) < 1e-9
+    # asymmetric check: difficult-only GT class disappears entirely
+    m = DetectionMAP(evaluate_difficult=False)
+    m.update(detections=[[7, 0.9, 0, 0, 10, 10]],
+             gt_boxes=[[0, 0, 10, 10]], gt_labels=[7],
+             gt_difficult=[True])
+    assert m.eval() == 0.0  # no classes with positives -> reference mAP 0

@@ -191,3 +191,107 @@ def test_chunk_eval_iob():
     got = run_op("chunk_eval", {"Inference": inf2, "Label": lab},
                  {"num_chunk_types": 1, "chunk_scheme": "IOB"})
     assert got["NumCorrectChunks"][0] == 1
+
+
+# ---- chunk_eval full-scheme parity (chunk_eval_op.h:27-198) -------------
+
+_SCHEMES = {
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _ref_segments(labels, num_chunk_types, scheme):
+    """Direct port of the reference GetSegments stateful walk."""
+    n_tags, t_beg, t_in, t_end, t_sin = _SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(pt, pty, tg, ty):
+        if pty == other: return False
+        if ty == other: return True
+        if ty != pty: return True
+        if pt == t_beg: return tg in (t_beg, t_sin)
+        if pt == t_in: return tg in (t_beg, t_sin)
+        if pt == t_end: return True
+        if pt == t_sin: return True
+        return False
+
+    def chunk_begin(pt, pty, tg, ty):
+        if pty == other: return ty != other
+        if ty == other: return False
+        if ty != pty: return True
+        if tg == t_beg: return True
+        if tg == t_in: return pt in (t_end, t_sin)
+        if tg == t_end: return pt in (t_end, t_sin)
+        if tg == t_sin: return True
+        return False
+
+    segs, in_chunk, start = [], False, 0
+    tag, typ = -1, other
+    for i, lab in enumerate(labels):
+        pt, pty = tag, typ
+        tag, typ = lab % n_tags, lab // n_tags
+        if in_chunk and chunk_end(pt, pty, tag, typ):
+            segs.append((start, i - 1, pty))
+            in_chunk = False
+        if chunk_begin(pt, pty, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(labels) - 1, typ))
+    return segs
+
+
+def _ref_chunk_eval(inf, lab, lengths, num_chunk_types, scheme, excluded=()):
+    ni = nl = nc = 0
+    for i in range(inf.shape[0]):
+        L = lengths[i] if lengths is not None else inf.shape[1]
+        si = [s for s in _ref_segments(list(inf[i, :L]), num_chunk_types, scheme)
+              if s[2] not in excluded]
+        sl = [s for s in _ref_segments(list(lab[i, :L]), num_chunk_types, scheme)
+              if s[2] not in excluded]
+        ni += len(si); nl += len(sl)
+        nc += len(set(si) & set(sl))
+    p = nc / ni if ni else 0.0
+    r = nc / nl if nl else 0.0
+    f = 2 * p * r / (p + r) if nc else 0.0
+    return p, r, f, ni, nl, nc
+
+
+@pytest.mark.parametrize("scheme", ["IOB", "IOE", "IOBES", "plain"])
+def test_chunk_eval_schemes_fuzz_vs_reference_walk(scheme):
+    import zlib
+    rng_ = np.random.RandomState(zlib.crc32(scheme.encode()))
+    n_types = 3
+    n_tags = _SCHEMES[scheme][0]
+    hi = n_types * n_tags + 1  # inclusive of the outside label
+    for trial in range(8):
+        b, t = rng_.randint(1, 5), rng_.randint(3, 12)
+        inf = rng_.randint(0, hi, (b, t)).astype(np.int64)
+        lab = rng_.randint(0, hi, (b, t)).astype(np.int64)
+        lengths = rng_.randint(1, t + 1, (b,)).astype(np.int64)
+        exp = _ref_chunk_eval(inf, lab, lengths, n_types, scheme)
+        got = run_op(
+            "chunk_eval",
+            {"Inference": inf, "Label": lab, "Length": lengths},
+            {"num_chunk_types": n_types, "chunk_scheme": scheme},
+        )
+        np.testing.assert_allclose(
+            [float(got["Precision"][0]), float(got["Recall"][0]),
+             float(got["F1-Score"][0])], exp[:3], atol=1e-6,
+            err_msg=f"{scheme} trial {trial}\ninf={inf}\nlab={lab}\nlen={lengths}")
+        assert (int(got["NumInferChunks"][0]), int(got["NumLabelChunks"][0]),
+                int(got["NumCorrectChunks"][0])) == exp[3:], (
+            f"{scheme} trial {trial}: counts {got} != {exp}")
+
+
+def test_chunk_eval_excluded_chunk_types():
+    inf = np.array([[0, 1, 4, 2, 4]], np.int64)   # B0 I0 O B1 O
+    lab = np.array([[0, 1, 4, 2, 4]], np.int64)
+    exp = _ref_chunk_eval(inf, lab, None, 2, "IOB", excluded=(1,))
+    got = run_op("chunk_eval", {"Inference": inf, "Label": lab},
+                 {"num_chunk_types": 2, "chunk_scheme": "IOB",
+                  "excluded_chunk_types": (1,)})
+    assert int(got["NumInferChunks"][0]) == exp[3] == 1
+    assert int(got["NumCorrectChunks"][0]) == exp[5] == 1
