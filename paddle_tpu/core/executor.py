@@ -131,6 +131,10 @@ class Executor:
         self.place = place
         self.mesh = mesh
         self.donate_state = donate_state
+        self._multiproc = mesh is not None and any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat
+        )
         self._cache = {}
 
     # ------------------------------------------------------------------
@@ -149,19 +153,38 @@ class Executor:
             v.name if hasattr(v, "name") else str(v) for v in fetch_list
         ]
         block = program.global_block()
+        multiproc = self._multiproc
         feed_vals = []
         for n in feed_names:
             val = feed[n]
             var = block._find_var(n)
             dtype = var.dtype if var is not None else None
             if isinstance(val, jax.Array):
+                if multiproc and val.sharding.is_fully_addressable:
+                    raise ValueError(
+                        f"feed {n!r} is a process-local jax.Array but the "
+                        f"mesh spans multiple processes; device_put it "
+                        f"with the global NamedSharding (or feed numpy — "
+                        f"each process's local batch shard)")
                 # already device-resident (e.g. a prefetched pipeline) —
                 # no host round-trip; coerce dtype on device if needed.
                 if dtype is not None and val.dtype != dtype:
                     val = val.astype(dtype)
                 feed_vals.append(val)
-            else:
-                feed_vals.append(np.asarray(val, dtype=dtype))
+                continue
+            val = np.asarray(val, dtype=dtype)
+            if multiproc:
+                # Multi-host mesh: each process feeds its LOCAL portion of
+                # the batch (the reference's per-trainer data convention);
+                # assemble the global jax.Array — jit rejects raw numpy
+                # with cross-process shardings.
+                from jax.sharding import NamedSharding, PartitionSpec
+                from ..parallel.api import _spec_for
+
+                spec = _spec_for(var, self.mesh) if var else PartitionSpec()
+                val = jax.make_array_from_process_local_data(
+                    NamedSharding(self.mesh, spec), val)
+            feed_vals.append(val)
 
         state_names = tuple(
             sorted(

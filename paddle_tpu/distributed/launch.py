@@ -77,21 +77,39 @@ def init_multihost(coordinator=None, num_processes=None, process_id=None,
             "set JAX_COORDINATOR_ADDRESS and JAX_NUM_PROCESSES too")
     if coordinator is None and num_processes in (None, 1):
         if _looks_like_pod():
-            # cloud TPU pod: jax autodetects everything from metadata.
-            # Too-late calls (XLA backend already up) and single-chip
-            # environments that merely carry TPU env markers degrade to
-            # single-host with a warning rather than failing.
-            try:
-                jax.distributed.initialize()
-            except RuntimeError as e:
-                import warnings
+            # cloud TPU pod: jax autodetects everything from metadata —
+            # but ONLY if the XLA backend has not been created yet
+            # (jax.distributed.initialize must run before any device use).
+            if _backend_up():
+                if _pod_is_multihost():
+                    raise RuntimeError(
+                        "init_multihost() called after the JAX backend was "
+                        "already initialized on a multi-worker TPU pod; "
+                        "call it before any jax.devices()/computation "
+                        "(e.g. first thing in main())")
+                # single-chip env that merely carries TPU markers: fine
+            else:
+                try:
+                    jax.distributed.initialize()
+                except RuntimeError as e:
+                    # env merely carries pod markers (e.g. CI container
+                    # with CLOUD_TPU_TASK_ID, no metadata server): degrade
+                    # to single-host rather than crash
+                    import warnings
 
-                warnings.warn(
-                    f"multi-host autodetection unavailable ({e}); "
-                    f"continuing single-host")
+                    warnings.warn(
+                        f"multi-host autodetection unavailable ({e}); "
+                        f"continuing single-host")
         # else: single host — nothing to coordinate
         _initialized = True
         return
+    if _backend_up() and not _distributed_client_up():
+        raise RuntimeError(
+            "init_multihost(coordinator=...) called after the JAX backend "
+            "was already initialized; the coordination service must be "
+            "joined before any jax.devices()/computation (reference "
+            "launchers start trainers with --trainer_id before building "
+            "the net for the same reason)")
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
@@ -100,6 +118,36 @@ def init_multihost(coordinator=None, num_processes=None, process_id=None,
     )
     _init_args = (coordinator, num_processes, process_id)
     _initialized = True
+
+
+def _backend_up():
+    """True once any XLA backend has been instantiated in this process."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def _distributed_client_up():
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def _pod_is_multihost():
+    """Positive evidence this is a multi-WORKER pod (not just an env that
+    carries TPU markers): >1 worker hostname, or a megascale coordinator.
+    Err on the side of True — silently stranding N hosts training alone is
+    worse than a hard error."""
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
 
 
 def _looks_like_pod():

@@ -1,0 +1,91 @@
+"""One process of a 2-process CPU "multi-host" run (spawned by
+test_distributed.py::test_multihost_two_process_cpu).  Each process joins
+the JAX coordination service via paddle_tpu.distributed.launch, forms a
+GLOBAL mesh spanning both processes' devices, checks a cross-process
+collective, and runs two data-parallel Executor training steps — the
+CPU-scale analog of the reference's multi-node trainers
+(paddle/scripts/cluster_train_v2, --trainer_id flags)."""
+
+import os
+import sys
+
+
+def main():
+    coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed import launch
+
+    launch.init_multihost(coordinator=coordinator, num_processes=nproc,
+                          process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    local = jax.local_device_count()
+    total = jax.device_count()
+    assert total == nproc * local, (total, local)
+    print(f"[{pid}] devices local={local} global={total}", flush=True)
+
+    # cross-process collective over the global mesh
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    mesh = launch.global_mesh({"dp": total})
+
+    @jax.jit
+    def global_sum():
+        def f():
+            return jax.lax.psum(
+                jnp.ones((), jnp.float32), "dp")
+
+        return shard_map(f, mesh=mesh, in_specs=(), out_specs=P())()
+
+    s = float(global_sum())
+    assert s == float(total), s
+    print(f"[{pid}] psum over dp = {s}", flush=True)
+
+    # data-parallel Executor training: each process feeds its LOCAL batch
+    # shard; the Executor assembles the global array over the dp mesh.
+    import paddle_tpu as pt
+    from paddle_tpu.parallel import api as papi
+
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        x = pt.layers.data("x", shape=[8], dtype="float32")
+        y = pt.layers.data("y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        cost = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    papi.data_parallel(main_p, "dp", programs=(startup,))
+
+    scope = pt.Scope()
+    exe = pt.Executor(mesh=mesh)
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)  # same seed: deterministic global data
+    xs = rng.randn(4 * total, 8).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype(np.float32)
+    lo = pid * 4 * local
+    xs_local, ys_local = xs[lo:lo + 4 * local], ys[lo:lo + 4 * local]
+    losses = []
+    for _ in range(2):
+        (l,) = exe.run(main_p, feed={"x": xs_local, "y": ys_local},
+                       fetch_list=[cost], scope=scope)
+        losses.append(float(np.asarray(l)))
+    assert np.isfinite(losses).all(), losses
+    assert losses[1] < losses[0], losses
+    # params are replicated over the global mesh -> fully addressable here
+    w = np.asarray(scope.get("fc_0.w"))
+    print(f"MULTIHOST_OK {pid} loss={losses[1]:.8f} wsum={float(w.sum()):.8f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
