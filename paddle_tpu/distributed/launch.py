@@ -146,6 +146,11 @@ def _pod_is_multihost():
     worse than a hard error."""
     if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
         return True
+    try:
+        if int(os.environ.get("TPU_WORKER_ID", "0")) > 0:
+            return True  # a non-zero worker id only exists on multi-worker
+    except ValueError:
+        pass
     hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     return len([h for h in hosts.split(",") if h.strip()]) > 1
 

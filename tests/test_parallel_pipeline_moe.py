@@ -182,3 +182,197 @@ class TestMoE:
         flat = jax.tree.leaves(g)
         assert all(np.isfinite(np.asarray(l)).all() for l in flat)
         assert any(float(jnp.abs(l).sum()) > 0 for l in flat)
+
+
+# ---- round 2: interleaved schedule + in-pipeline embed/head -------------
+
+def _mlp_stage_r2(params, h):
+    return h + jnp.tanh(h @ params["w"] + params["b"])
+
+
+def _make_stages_r2(n, d, key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), n)
+    return [{"w": jax.random.normal(k, (d, d)) * 0.3,
+             "b": jnp.full((d,), 0.01)} for k in ks]
+
+
+@pytest.mark.parametrize("m", [4, 8])
+def test_pipeline_interleaved_matches_sequential(m):
+    """virtual_stages=2: 8 stages on a 4-device pp ring, every microbatch
+    making 2 laps; output must equal the sequential 8-stage composition,
+    and grads must match too."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline import pipeline, stack_stage_params
+
+    pp, v, d, b = 4, 2, 8, 2 * m
+    mesh = make_mesh({"pp": pp}, devices=jax.devices()[:pp])
+    stages = _make_stages_r2(v * pp, d)
+    sp = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(9), (b, d))
+
+    def seq(sp, x):
+        h = x
+        for s in range(v * pp):
+            h = _mlp_stage_r2(jax.tree.map(lambda p: p[s], sp), h)
+        return h
+
+    got = jax.jit(lambda sp, x: pipeline(
+        _mlp_stage_r2, sp, x, mesh, num_microbatches=m, virtual_stages=v))(
+            sp, x)
+    want = seq(sp, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_pipe(sp):
+        out = pipeline(_mlp_stage_r2, sp, x, mesh, num_microbatches=m,
+                       virtual_stages=v)
+        return jnp.mean(out ** 2)
+
+    def loss_seq(sp):
+        return jnp.mean(seq(sp, x) ** 2)
+
+    g1 = jax.jit(jax.grad(loss_pipe))(sp)
+    g2 = jax.grad(loss_seq)(sp)
+    for a, e in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_interleaved_needs_enough_microbatches():
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline import pipeline, stack_stage_params
+
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    sp = stack_stage_params(_make_stages_r2(8, 4))
+    x = jnp.zeros((4, 4))
+    with pytest.raises(ValueError, match="num_microbatches >= pp"):
+        pipeline(_mlp_stage_r2, sp, x, mesh, num_microbatches=2,
+                 virtual_stages=2)
+
+
+def test_pipeline_lm_embed_and_head_inside():
+    """Unequal first/last layers INSIDE the pipelined region: token
+    embedding on stage 0, loss head on the final stage; loss and all
+    grads (embed, blocks, head) match the sequential model."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline import pipeline_lm, stack_stage_params
+
+    pp, d, vocab, tlen, m = 4, 8, 12, 5, 4
+    b = 2 * m
+    mesh = make_mesh({"pp": pp}, devices=jax.devices()[:pp])
+    stages = _make_stages_r2(pp, d, key=3)
+    sp = stack_stage_params(stages)
+    emb = {"table": jax.random.normal(jax.random.PRNGKey(4), (vocab, d)) * 0.2}
+    head = {"w": jax.random.normal(jax.random.PRNGKey(5), (d, vocab)) * 0.2}
+    tok = jax.random.randint(jax.random.PRNGKey(6), (b, tlen), 0, vocab)
+    tgt = jax.random.randint(jax.random.PRNGKey(7), (b, tlen), 0, vocab)
+
+    def embed_fn(p, tok):
+        return p["table"][tok]
+
+    def head_loss_fn(p, h, tgt):
+        logits = h @ p["w"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, tgt[..., None], axis=-1))
+
+    def seq_loss(emb, sp, head):
+        h = embed_fn(emb, tok.reshape(m, b // m, tlen))
+        # sequential over microbatches to mirror per-microbatch mean
+        losses = []
+        for j in range(m):
+            hj = h[j]
+            for s in range(pp):
+                hj = _mlp_stage_r2(jax.tree.map(lambda p: p[s], sp), hj)
+            losses.append(head_loss_fn(
+                head, hj, tgt.reshape(m, b // m, tlen)[j]))
+        return jnp.mean(jnp.stack(losses))
+
+    def pipe_loss(emb, sp, head):
+        return pipeline_lm(embed_fn, _mlp_stage_r2, head_loss_fn,
+                           emb, sp, head, tok, tgt, mesh,
+                           num_microbatches=m)
+
+    lp = jax.jit(pipe_loss)(emb, sp, head)
+    ls = seq_loss(emb, sp, head)
+    np.testing.assert_allclose(float(lp), float(ls), rtol=2e-5)
+
+    gp = jax.jit(jax.grad(pipe_loss, argnums=(0, 1, 2)))(emb, sp, head)
+    gs = jax.grad(seq_loss, argnums=(0, 1, 2))(emb, sp, head)
+    for a, e in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_lm_composes_with_dp():
+    """pp=2 x dp=2: pipeline_lm over a 2-axis mesh with the batch sharded
+    over dp; loss equals the pp-only value on the same data."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline import pipeline_lm, stack_stage_params
+
+    pp, d, vocab, tlen, m = 2, 4, 6, 3, 2
+    b = 4
+    stages = _make_stages_r2(pp, d, key=8)
+    sp = stack_stage_params(stages)
+    emb = {"table": jax.random.normal(jax.random.PRNGKey(1), (vocab, d))}
+    head = {"w": jax.random.normal(jax.random.PRNGKey(2), (d, vocab))}
+    tok = jax.random.randint(jax.random.PRNGKey(3), (b, tlen), 0, vocab)
+    tgt = jax.random.randint(jax.random.PRNGKey(4), (b, tlen), 0, vocab)
+
+    def embed_fn(p, tok):
+        return p["table"][tok]
+
+    def head_loss_fn(p, h, tgt):
+        logits = h @ p["w"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, tgt[..., None], axis=-1))
+
+    mesh_pp = make_mesh({"pp": pp}, devices=jax.devices()[:pp])
+    l_ref = pipeline_lm(embed_fn, _mlp_stage_r2, head_loss_fn, emb, sp, head,
+                        tok, tgt, mesh_pp, num_microbatches=m)
+    mesh2 = make_mesh({"pp": pp, "dp": 2}, devices=jax.devices()[:4])
+    l_dp = pipeline_lm(embed_fn, _mlp_stage_r2, head_loss_fn, emb, sp, head,
+                       tok, tgt, mesh2, num_microbatches=m,
+                       batch_axis="dp")
+    np.testing.assert_allclose(float(l_dp), float(l_ref), rtol=2e-5)
+
+
+def test_pipeline_lm_interleaved():
+    """pipeline_lm with virtual_stages=2 (shared schedule machinery):
+    loss matches the sequential 2*pp-stage model."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline import pipeline_lm, stack_stage_params
+
+    pp, v, d, vocab, tlen, m = 2, 2, 4, 6, 3, 4
+    b = 2 * m
+    mesh = make_mesh({"pp": pp}, devices=jax.devices()[:pp])
+    stages = _make_stages_r2(v * pp, d, key=13)
+    sp = stack_stage_params(stages)
+    emb = {"table": jax.random.normal(jax.random.PRNGKey(1), (vocab, d))}
+    head = {"w": jax.random.normal(jax.random.PRNGKey(2), (d, vocab))}
+    tok = jax.random.randint(jax.random.PRNGKey(3), (b, tlen), 0, vocab)
+    tgt = jax.random.randint(jax.random.PRNGKey(4), (b, tlen), 0, vocab)
+
+    def embed_fn(p, tok):
+        return p["table"][tok]
+
+    def head_loss_fn(p, h, tgt):
+        logp = jax.nn.log_softmax(h @ p["w"])
+        return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], axis=-1))
+
+    lp = pipeline_lm(embed_fn, _mlp_stage_r2, head_loss_fn, emb, sp, head,
+                     tok, tgt, mesh, num_microbatches=m, virtual_stages=v)
+
+    # interleaved placement: stage s = r*pp + d executes in order
+    # lap 0 (stages 0..pp-1), then lap 1 (stages pp..2pp-1)
+    losses = []
+    tok_m = tok.reshape(m, b // m, tlen)
+    tgt_m = tgt.reshape(m, b // m, tlen)
+    for j in range(m):
+        h = embed_fn(emb, tok_m[j])
+        for s in range(v * pp):
+            h = _mlp_stage_r2(jax.tree.map(lambda p: p[s], sp), h)
+        losses.append(head_loss_fn(head, h, tgt_m[j]))
+    np.testing.assert_allclose(float(lp), float(jnp.mean(jnp.stack(losses))),
+                               rtol=2e-5)
