@@ -84,3 +84,39 @@ def test_every_reference_op_is_carried():
 
 def test_registry_is_larger_than_reference():
     assert len(registered_ops()) >= 150
+
+
+def _directly_tested_ops():
+    """Scan the test suite for ops exercised by name: eager harness calls
+    (run_op/check_output/check_grad), program construction
+    (append_op(type=...)), and program assertions (op.type == ...)."""
+    import glob
+    import os
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    tested = set()
+    for f in glob.glob(os.path.join(here, "test_*.py")):
+        src = open(f).read()
+        for pat in (
+            r'(?:run_op|check_output|check_grad)\(\s*[\'"](\w+)[\'"]',
+            r'type=[\'"](\w+)[\'"]',
+            r'op\.type == [\'"](\w+)[\'"]',
+            # parametrized case tables: ("op_name", {attrs...}, ...)
+            r'\(\s*[\'"](\w+)[\'"]\s*,\s*\{',
+        ):
+            tested.update(m.group(1) for m in re.finditer(pat, src))
+    return tested
+
+
+def test_every_registered_op_has_a_direct_test():
+    """VERDICT r1 item 3: tested ⊇ registered.  Every op must be exercised
+    by name somewhere in the suite — eagerly via the op_test harness, or
+    (for raw/structured ops) through a program that provably contains it
+    (the `op.type == "x"` assertion pattern in test_ops_control_flow.py)."""
+    ours = set(registered_ops())
+    tested = _directly_tested_ops()
+    missing = sorted(ours - tested)
+    assert not missing, (
+        f"{len(missing)} registered op(s) with no direct test: {missing}"
+    )

@@ -1,0 +1,179 @@
+"""Direct tests for the raw (structured / meta) ops — while,
+conditional_block, scan_block, parallel_do, feed/fetch, print, save/load,
+and the tensor-array trio — each exercised through a real Program +
+Executor lowering (these ops splice sub-blocks, so an eager run_op cannot
+drive them).  VERDICT r1 item 3 coverage for the raw-op tail."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from op_test import run_op
+
+
+def _run(main, startup, feed, fetches, scope=None):
+    scope = scope or pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    return exe.run(main, feed=feed, fetch_list=fetches, scope=scope), scope
+
+
+def test_array_ops_direct():
+    arr = np.zeros((4, 2, 3), np.float32)
+    x = np.ones((2, 3), np.float32) * 5
+    i = np.array([2], np.int64)
+    got = run_op("array_write", {"X": x, "I": i, "Array": arr})
+    assert np.abs(got["Out"][2] - 5).max() == 0 and got["Out"][0].max() == 0
+    got2 = run_op("array_read", {"Array": got["Out"], "I": i})
+    np.testing.assert_array_equal(got2["Out"], x)
+    got3 = run_op("array_length", {"Array": arr})
+    np.testing.assert_array_equal(got3["Out"], [4])
+
+
+def test_while_op_accumulates():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        limit = layers.fill_constant(shape=[1], dtype="float32", value=5.0)
+        i = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        total = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        from paddle_tpu.layers import control_flow as cf
+
+        cond = layers.less_than(i, limit)
+        w = cf.While(cond)
+        with w.block():
+            layers.sums([total, i], out=total)
+            layers.increment(i, 1.0)
+            layers.assign(layers.less_than(i, limit), cond)
+    assert any(op.type == "while" for op in main.global_block().ops)
+    (out, ival), _ = _run(main, startup, {}, [total, i])
+    assert float(ival) == 5.0
+    assert float(out) == 0 + 1 + 2 + 3 + 4
+
+
+def test_conditional_block_both_branches():
+    def build(flag):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[2], dtype="float32")
+            cond = layers.fill_constant(shape=[1], dtype="bool", value=flag)
+            out = layers.fill_constant(shape=[1, 2], dtype="float32",
+                                       value=-1.0)
+            blk = main.create_block()
+            main.rollback()
+            # sub-block: out = x * 10
+            blk.append_op(
+                type="scale", inputs={"X": [x.name]},
+                outputs={"Out": [out.name]}, attrs={"scale": 10.0})
+            main.current_block().append_op(
+                type="conditional_block",
+                inputs={"Cond": [cond.name]},
+                outputs={"Out": [out.name]},
+                attrs={"sub_block": blk.idx})
+        assert any(op.type == "conditional_block"
+                   for op in main.global_block().ops)
+        (got,), _ = _run(main, startup,
+                         {"x": np.array([[1.0, 2.0]], np.float32)}, [out])
+        return got
+
+    np.testing.assert_allclose(build(True), [[10.0, 20.0]])
+    np.testing.assert_allclose(build(False), [[-1.0, -1.0]])
+
+
+def test_scan_block_via_static_rnn():
+    """scan_block through the StaticRNN builder: h_t = h_{t-1} + x_t."""
+    from paddle_tpu.layers import control_flow as cf
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 2], dtype="float32")  # [b, t, d]
+        init = layers.fill_constant(shape=[2, 2], dtype="float32", value=0.0)
+        rnn = cf.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(init)
+            nh = layers.elementwise_add(h, xt)
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()
+    assert any(op.type == "scan_block" for op in main.global_block().ops)
+    xv = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+    (got,), _ = _run(main, startup, {"x": xv}, [out])
+    np.testing.assert_allclose(got, np.cumsum(xv, axis=1), rtol=1e-6)
+
+
+def test_parallel_do_inlines_block():
+    from paddle_tpu.layers import control_flow as cf
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        pd = cf.ParallelDo()
+        with pd.do():
+            xi = pd.read_input(x)
+            y = layers.scale(xi, scale=3.0)
+            pd.write_output(y)
+    assert any(op.type == "parallel_do" for op in main.global_block().ops)
+    xv = np.array([[1.0, -2.0]], np.float32)
+    (got,), _ = _run(main, startup, {"x": xv}, [y])
+    np.testing.assert_allclose(got, 3.0 * xv)
+
+
+def test_feed_fetch_ops_are_program_noops():
+    """feed/fetch ops exist for program parity (feed_fetch_method.h); a
+    program carrying them lowers and runs — the jit boundary realizes
+    them."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        out = layers.scale(x, scale=2.0)
+        blk = main.global_block()
+        blk.append_op(type="feed", inputs={}, outputs={}, attrs={})
+        blk.append_op(type="fetch", inputs={}, outputs={}, attrs={})
+    assert any(op.type == "feed" for op in main.global_block().ops)
+    assert any(op.type == "fetch" for op in main.global_block().ops)
+    xv = np.array([[3.0, 4.0]], np.float32)
+    (got,), _ = _run(main, startup, {"x": xv}, [out])
+    np.testing.assert_allclose(got, 2.0 * xv)
+
+
+def test_print_op_passes_through(capfd):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        out = layers.Print(x, message="dbg") if hasattr(layers, "Print") \
+            else None
+        if out is None:
+            blk = main.global_block()
+            out = layers.scale(x, scale=1.0)
+            blk.append_op(type="print", inputs={"In": [x.name]},
+                          outputs={}, attrs={"message": "dbg"})
+    assert any(op.type == "print" for op in main.global_block().ops)
+    xv = np.array([[1.0, 2.0]], np.float32)
+    (got,), _ = _run(main, startup, {"x": xv}, [out])
+    np.testing.assert_allclose(got, xv)
+
+
+def test_save_load_ops_raise_with_host_side_pointer():
+    """save/load ops deliberately refuse to lower (host IO can't live in a
+    compiled TPU program); the host-side io module is the carrier."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+        main.global_block().append_op(
+            type="save", inputs={"X": [x.name]}, outputs={},
+            attrs={"file_path": "/tmp/x"})
+    assert any(op.type == "save" for op in main.global_block().ops)
+    exe = pt.Executor()
+    with pytest.raises(RuntimeError, match="save_persistables"):
+        exe.run(main, feed={}, fetch_list=[x], scope=pt.Scope())
+
+    main2 = pt.Program()
+    with pt.program_guard(main2, pt.Program()):
+        y = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+        main2.global_block().append_op(
+            type="load", inputs={}, outputs={"Out": [y.name]},
+            attrs={"file_path": "/tmp/x"})
+    assert any(op.type == "load" for op in main2.global_block().ops)
+    with pytest.raises(RuntimeError, match="load_persistables"):
+        pt.Executor().run(main2, feed={}, fetch_list=[y], scope=pt.Scope())

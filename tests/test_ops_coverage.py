@@ -1,0 +1,362 @@
+"""Direct tests for the remaining previously-untested registered ops
+(VERDICT r1 item 3: one direct test per op) — elementwise/compare/logical,
+tensor/fill/shape, lookup/embedding-grad, sequence, random, attention,
+detection, and beam-search-decode ops, each vs an independent numpy
+reference."""
+
+import numpy as np
+import pytest
+
+from op_test import check_output, check_grad, run_op
+
+rng = np.random.RandomState(23)
+
+
+# ---------------- elementwise / compare / logical -----------------------
+
+def test_elementwise_div_min_pow():
+    x = rng.uniform(1.0, 3.0, (3, 4)).astype(np.float32)
+    y = rng.uniform(1.0, 2.0, (3, 4)).astype(np.float32)
+    check_output("elementwise_div", {"X": x, "Y": y}, {"Out": x / y},
+                 atol=1e-5)
+    check_output("elementwise_min", {"X": x, "Y": y},
+                 {"Out": np.minimum(x, y)}, atol=1e-6)
+    check_output("elementwise_pow", {"X": x, "Y": y}, {"Out": x ** y},
+                 atol=1e-4, rtol=1e-4)
+    check_grad("elementwise_div", {"X": x, "Y": y}, "X",
+               max_relative_error=5e-3)
+    check_grad("elementwise_div", {"X": x, "Y": y}, "Y",
+               max_relative_error=5e-3)
+
+
+def test_elementwise_broadcast_axis():
+    """axis semantics of the reference elementwise ops: Y's dims align to
+    X starting at `axis`."""
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    y = rng.uniform(1.0, 2.0, (3,)).astype(np.float32)
+    got = run_op("elementwise_div", {"X": x, "Y": y}, {"axis": 1})
+    np.testing.assert_allclose(got["Out"], x / y[None, :, None], rtol=1e-5)
+
+
+def test_compare_ops():
+    x = rng.randint(0, 3, (4, 3)).astype(np.float32)
+    y = rng.randint(0, 3, (4, 3)).astype(np.float32)
+    check_output("equal", {"X": x, "Y": y}, {"Out": x == y})
+    check_output("not_equal", {"X": x, "Y": y}, {"Out": x != y})
+    check_output("greater_than", {"X": x, "Y": y}, {"Out": x > y})
+    check_output("less_equal", {"X": x, "Y": y}, {"Out": x <= y})
+
+
+def test_logical_or():
+    x = rng.rand(3, 3) > 0.5
+    y = rng.rand(3, 3) > 0.5
+    check_output("logical_or", {"X": x, "Y": y},
+                 {"Out": np.logical_or(x, y)})
+
+
+def test_minus_dot_mean():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    check_output("minus", {"X": x, "Y": y}, {"Out": x - y}, atol=1e-6)
+    check_output("dot", {"X": x, "Y": y},
+                 {"Out": np.sum(x * y, axis=-1, keepdims=True)}, atol=1e-5)
+    check_output("mean", {"X": x}, {"Out": np.mean(x).reshape(1)},
+                 atol=1e-6)
+    check_grad("mean", {"X": x}, "X", max_relative_error=5e-3)
+
+
+# ---------------- tensor / fill / shape ---------------------------------
+
+def test_fill_and_assign_family():
+    got = run_op("fill_constant", {}, {"shape": (2, 3), "dtype": "float32",
+                                       "value": 2.5})
+    np.testing.assert_array_equal(got["Out"], np.full((2, 3), 2.5, np.float32))
+
+    ref = rng.randn(5, 4).astype(np.float32)
+    got = run_op("fill_constant_batch_size_like",
+                 {"Input": ref},
+                 {"shape": (1, 7), "dtype": "float32", "value": 1.0,
+                  "input_dim_idx": 0, "output_dim_idx": 0})
+    assert got["Out"].shape == (5, 7) and (got["Out"] == 1.0).all()
+
+    x = rng.randn(2, 2).astype(np.float32)
+    np.testing.assert_array_equal(run_op("assign", {"X": x})["Out"], x)
+
+    got = run_op("assign_value", {},
+                 {"shape": (2, 2), "dtype": "float32",
+                  "values": (1.0, 2.0, 3.0, 4.0)})
+    np.testing.assert_array_equal(
+        got["Out"], np.array([[1, 2], [3, 4]], np.float32))
+
+
+def test_shape_argmax_argmin_increment_isempty():
+    x = rng.randn(3, 5).astype(np.float32)
+    np.testing.assert_array_equal(
+        run_op("shape", {"Input": x})["Out"], np.array([3, 5], np.int32))
+    np.testing.assert_array_equal(
+        run_op("arg_max", {"X": x}, {"axis": 1})["Out"],
+        np.argmax(x, axis=1).astype(np.int32))
+    np.testing.assert_array_equal(
+        run_op("arg_min", {"X": x}, {"axis": 0})["Out"],
+        np.argmin(x, axis=0).astype(np.int32))
+    np.testing.assert_allclose(
+        run_op("increment", {"X": np.array([2.0], np.float32)},
+               {"step": 3.0})["Out"], [5.0])
+    assert not bool(np.asarray(run_op("is_empty", {"X": x})["Out"]))
+    assert bool(np.asarray(
+        run_op("is_empty", {"X": np.zeros((0, 2), np.float32)})["Out"]))
+
+
+def test_reshape_reduce_min_prod():
+    x = rng.uniform(0.5, 2.0, (2, 6)).astype(np.float32)
+    got = run_op("reshape", {"X": x}, {"shape": (3, 4)})
+    np.testing.assert_array_equal(got["Out"], x.reshape(3, 4))
+    check_output("reduce_min", {"X": x}, {"Out": np.min(x, axis=None)},
+                 attrs={"reduce_all": True}, atol=1e-6)
+    got = run_op("reduce_min", {"X": x}, {"dim": 1})
+    np.testing.assert_allclose(got["Out"], np.min(x, axis=1), rtol=1e-6)
+    got = run_op("reduce_prod", {"X": x}, {"dim": 1})
+    np.testing.assert_allclose(got["Out"], np.prod(x, axis=1), rtol=1e-4)
+    check_grad("reduce_prod", {"X": x}, "X", attrs={"dim": 1},
+               max_relative_error=5e-3)
+
+
+def test_lookup_table_and_grad_rows():
+    w = rng.randn(10, 4).astype(np.float32)
+    ids = np.array([[1], [7], [1], [9]], np.int64)
+    got = run_op("lookup_table", {"W": w, "Ids": ids})
+    np.testing.assert_allclose(got["Out"], w[ids.ravel()], rtol=1e-6)
+
+    # padding_idx rows come back zero (lookup_table_op.cc padding_idx)
+    got = run_op("lookup_table", {"W": w, "Ids": ids}, {"padding_idx": 7})
+    exp = w[ids.ravel()].copy()
+    exp[1] = 0.0
+    np.testing.assert_allclose(got["Out"], exp, rtol=1e-6)
+
+    # embedding_grad_rows scatter-adds duplicate ids (SelectedRows merge)
+    g = rng.randn(4, 4).astype(np.float32)
+    got = run_op("embedding_grad_rows", {"Grad": g, "Ids": ids},
+                 {"table_height": 10})
+    exp = np.zeros((10, 4), np.float32)
+    for row, i in zip(g, ids.ravel()):
+        exp[i] += row
+    np.testing.assert_allclose(got["Out"], exp, rtol=1e-5, atol=1e-6)
+
+
+def test_error_clip_clips_cotangent_not_value():
+    x = rng.randn(3, 3).astype(np.float32) * 10
+    got = run_op("error_clip", {"X": x}, {"max": 0.5})
+    np.testing.assert_array_equal(got["Out"], x)  # identity forward
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op_impl
+
+    impl = get_op_impl("error_clip")
+
+    def f(x):
+        return jnp.sum(impl.call({"X": x}, {"max": 0.5}, None)["Out"] * 10.0)
+
+    g = jax.grad(f)(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), np.full_like(x, 0.5))
+
+
+# ---------------- random ops --------------------------------------------
+
+def test_dropout_train_and_test_mode():
+    x = np.ones((200, 50), np.float32)
+    got = run_op("dropout", {"X": x},
+                 {"dropout_prob": 0.3, "fix_seed": True, "seed": 7})
+    keep_rate = got["Mask"].mean()
+    assert 0.6 < keep_rate < 0.8  # ~0.7
+    np.testing.assert_array_equal(got["Out"], x * got["Mask"])
+    # v0.11 semantics: test mode scales by (1-p), train does NOT rescale
+    got = run_op("dropout", {"X": x}, {"dropout_prob": 0.3, "is_test": True})
+    np.testing.assert_allclose(got["Out"], x * 0.7, rtol=1e-6)
+
+
+def test_random_crop():
+    x = rng.randn(8, 8, 3).astype(np.float32)
+    got = run_op("random_crop", {"X": x}, {"shape": (5, 5, 3)})
+    out = np.asarray(got["Out"])
+    assert out.shape == (5, 5, 3)
+    # the crop must be a contiguous sub-block of x
+    found = any(
+        np.array_equal(out, x[i:i + 5, j:j + 5])
+        for i in range(4) for j in range(4)
+    )
+    assert found
+
+
+# ---------------- attention / conv3d ------------------------------------
+
+def test_flash_attention_op_vs_naive():
+    b, t, h, d = 2, 16, 2, 8
+    q = rng.randn(b, t, h, d).astype(np.float32) * 0.5
+    k = rng.randn(b, t, h, d).astype(np.float32) * 0.5
+    v = rng.randn(b, t, h, d).astype(np.float32)
+
+    def naive(q, k, v, causal):
+        scale = d ** -0.5
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            mask = np.tril(np.ones((t, t), bool))
+            logits = np.where(mask[None, None], logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+    for causal in (False, True):
+        got = run_op("flash_attention", {"Q": q, "K": k, "V": v},
+                     {"causal": causal})
+        np.testing.assert_allclose(got["Out"], naive(q, k, v, causal),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_conv3d_vs_loop_reference():
+    x = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+    w = rng.randn(3, 2, 2, 2, 2).astype(np.float32)
+    got = run_op("conv3d", {"Input": x, "Filter": w},
+                 {"strides": (1, 1, 1), "paddings": (0, 0, 0),
+                  "dilations": (1, 1, 1), "groups": 1})
+    out = np.zeros((1, 3, 3, 3, 3), np.float32)
+    for z in range(3):
+        for i in range(3):
+            for j in range(3):
+                patch = x[:, :, z:z + 2, i:i + 2, j:j + 2]
+                out[:, :, z, i, j] = np.einsum("ncdhw,ocdhw->no", patch, w)
+    np.testing.assert_allclose(got["Output"], out, rtol=1e-3, atol=1e-4)
+
+
+# ---------------- sequence ops ------------------------------------------
+
+def test_sequence_concat_lengths_add():
+    x1 = rng.randn(2, 3, 2).astype(np.float32)
+    x2 = rng.randn(2, 4, 2).astype(np.float32)
+    l1 = np.array([2, 3], np.int32)
+    l2 = np.array([4, 1], np.int32)
+    got = run_op("sequence_concat",
+                 {"X": [x1, x2], "Length": [l1, l2]}, {"axis": 1})
+    np.testing.assert_array_equal(got["OutLength"], [6, 4])
+    # row 0: x1[0,:2] then x2[0,:4]
+    np.testing.assert_allclose(got["Out"][0, :2], x1[0, :2], rtol=1e-6)
+    np.testing.assert_allclose(got["Out"][0, 2:6], x2[0, :4], rtol=1e-6)
+    # row 1: x1[1,:3] then x2[1,:1]
+    np.testing.assert_allclose(got["Out"][1, :3], x1[1, :3], rtol=1e-6)
+    np.testing.assert_allclose(got["Out"][1, 3:4], x2[1, :1], rtol=1e-6)
+
+
+def test_sequence_reshape_rescales_lengths():
+    x = rng.randn(2, 4, 6).astype(np.float32)
+    ln = np.array([4, 2], np.int32)
+    got = run_op("sequence_reshape", {"X": x, "Length": ln}, {"new_dim": 3})
+    assert got["Out"].shape == (2, 8, 3)
+    np.testing.assert_array_equal(got["OutLength"], [8, 4])
+    np.testing.assert_allclose(got["Out"][0].ravel(), x[0].ravel(),
+                               rtol=1e-6)
+
+
+def test_sequence_scale_and_slice():
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    s = np.array([2.0, -1.0], np.float32)
+    got = run_op("sequence_scale", {"X": x, "Scales": s})
+    np.testing.assert_allclose(got["Out"][0], 2.0 * x[0], rtol=1e-6)
+    np.testing.assert_allclose(got["Out"][1], -x[1], rtol=1e-6)
+
+    off = np.array([[1], [0]], np.int64)
+    ln = np.array([[3], [2]], np.int64)
+    got = run_op("sequence_slice", {"X": x, "Offset": off, "SeqLength": ln})
+    np.testing.assert_array_equal(got["OutLength"], [3, 2])
+    np.testing.assert_allclose(got["Out"][0, :3], x[0, 1:4], rtol=1e-6)
+    np.testing.assert_allclose(got["Out"][1, :2], x[1, 0:2], rtol=1e-6)
+    assert np.abs(got["Out"][0, 3:]).max() == 0.0
+
+
+# ---------------- beam search decode / detection ------------------------
+
+def test_beam_search_decode_backtracks():
+    # T=3, b=1, k=2: hand-built beams.
+    # step0: ids [[5, 6]], parents [[0, 1]]
+    # step1: ids [[7, 8]], parents [[0, 0]]   (both continue beam 0)
+    # step2: ids [[9, 1]], parents [[1, 0]]   (end_id=1 ends slot 1)
+    ids = np.array([[[5, 6]], [[7, 8]], [[9, 1]]], np.int32)
+    parents = np.array([[[0, 1]], [[0, 0]], [[1, 0]]], np.int32)
+    got = run_op("beam_search_decode", {"Ids": ids, "ParentIdx": parents},
+                 {"end_id": 1})
+    sent = np.asarray(got["SentenceIds"])
+    # slot 0 backtracks: step2 id 9 <- parent 1 -> step1 id 8 <- parent 0
+    # -> step0 id 5
+    np.testing.assert_array_equal(sent[0, 0], [5, 8, 9])
+    # slot 1: step2 id 1(end) <- parent 0 -> step1 id 7 <- step0 id 5
+    np.testing.assert_array_equal(sent[0, 1], [5, 7, 1])
+
+
+def test_detection_output_decodes_and_nms():
+    # one prior, one foreground class, trivially decodable
+    prior = np.array([[0.2, 0.2, 0.4, 0.4]], np.float32)
+    loc = np.zeros((1, 1, 4), np.float32)  # zero offsets -> box == prior
+    conf = np.array([[[0.1, 0.9]]], np.float32)  # background, class1
+    got = run_op("detection_output",
+                 {"Loc": loc, "Conf": conf, "PriorBox": prior},
+                 {"background_label": 0, "score_threshold": 0.5})
+    out = np.asarray(got["Out"])
+    rows = out[0] if out.ndim == 3 else out
+    kept = rows[rows[:, 0] >= 0]
+    assert len(kept) == 1
+    assert kept[0][0] == 1.0 and abs(kept[0][1] - 0.9) < 1e-5
+    np.testing.assert_allclose(kept[0][2:], prior[0], atol=1e-5)
+
+
+# ---------------- round-2 additions: v1 long-tail carrier ops ------------
+
+def test_bilinear_interp_align_corners():
+    x = np.array([[[[0.0, 1.0], [2.0, 3.0]]]], np.float32)
+    got = run_op("bilinear_interp", {"X": x}, {"out_h": 3, "out_w": 3})
+    exp = np.array([[0, 0.5, 1], [1, 1.5, 2], [2, 2.5, 3]], np.float32)
+    np.testing.assert_allclose(got["Out"][0, 0], exp, atol=1e-6)
+    check_grad("bilinear_interp", {"X": rng.rand(1, 2, 3, 3).astype(np.float32)},
+               "X", attrs={"out_h": 5, "out_w": 4}, max_relative_error=5e-3)
+
+
+def test_sampling_id_follows_distribution():
+    p = np.array([[0.999, 0.001], [0.001, 0.999]], np.float32)
+    got = run_op("sampling_id", {"X": p})
+    assert got["Out"][0] == 0 and got["Out"][1] == 1
+    # statistically: ~uniform over many rows
+    p2 = np.full((2000, 4), 0.25, np.float32)
+    ids = run_op("sampling_id", {"X": p2})["Out"]
+    counts = np.bincount(np.asarray(ids), minlength=4) / 2000
+    assert np.abs(counts - 0.25).max() < 0.06, counts
+
+
+def test_scale_sub_region():
+    x = np.ones((1, 2, 3, 3), np.float32)
+    ind = np.array([[1, 1, 2, 3, 1, 2]], np.int32)
+    got = run_op("scale_sub_region", {"X": x, "Indices": ind},
+                 {"value": 5.0})
+    exp = x.copy()
+    exp[0, 0, 1:3, 0:2] = 5.0
+    np.testing.assert_array_equal(got["Out"], exp)
+
+
+def test_multibox_loss_matching_and_mining():
+    prior = np.array([[0.1, 0.1, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9],
+                      [0.4, 0.1, 0.5, 0.2]], np.float32)
+    gt = np.array([[[0.1, 0.1, 0.3, 0.3], [0, 0, 0, 0]]], np.float32)
+    gl = np.array([[1, -1]], np.int32)  # one real box, one padding
+    loc = np.zeros((1, 3, 4), np.float32)
+    conf_good = np.zeros((1, 3, 3), np.float32)
+    conf_good[0, 0, 1] = 8.0   # matched prior confident in class 1
+    conf_good[0, 1, 0] = 8.0   # negatives confident background
+    conf_good[0, 2, 0] = 8.0
+    conf_bad = np.zeros((1, 3, 3), np.float32)
+    conf_bad[0, 0, 0] = 8.0    # matched prior says background
+    good = run_op("multibox_loss",
+                  {"Loc": loc, "Conf": conf_good, "PriorBox": prior,
+                   "GtBox": gt, "GtLabel": gl})["Loss"]
+    bad = run_op("multibox_loss",
+                 {"Loc": loc, "Conf": conf_bad, "PriorBox": prior,
+                  "GtBox": gt, "GtLabel": gl})["Loss"]
+    assert float(good) < 0.1 < float(bad)
+    # zero loc offsets on an exactly-matching prior: loc loss ~ 0, so the
+    # good case is nearly pure (tiny) conf loss
+    assert np.isfinite(good).all() and np.isfinite(bad).all()

@@ -1,0 +1,203 @@
+"""Direct tests for every optimizer update op against a numpy port of the
+reference kernel (sgd_op.h, momentum_op.h, adam_op.h, adamax_op.h,
+adagrad_op.h, adadelta_op.h, decayed_adagrad_op.h, rmsprop_op.h,
+ftrl_op.h, proximal_gd_op.h, proximal_adagrad_op.h), chained over several
+steps so accumulator conventions (e.g. the Beta1Pow running product) are
+pinned, not just a single application."""
+
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+rng = np.random.RandomState(5)
+
+
+def _p():
+    return rng.randn(4, 3).astype(np.float32)
+
+
+def _steps(n=3):
+    return [rng.randn(4, 3).astype(np.float32) * 0.5 for _ in range(n)]
+
+
+LR = np.array([0.1], np.float32)
+
+
+def test_sgd():
+    p = _p()
+    for g in _steps():
+        got = run_op("sgd", {"Param": p, "Grad": g, "LearningRate": LR})
+        p = p - 0.1 * g
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-5, atol=1e-6)
+        p = got["ParamOut"]
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_momentum(nesterov):
+    p, v = _p(), np.zeros((4, 3), np.float32)
+    mu = 0.9
+    for g in _steps():
+        got = run_op("momentum",
+                     {"Param": p, "Grad": g, "Velocity": v,
+                      "LearningRate": LR},
+                     {"mu": mu, "use_nesterov": nesterov})
+        v = mu * v + g
+        p = p - (g + mu * v) * 0.1 if nesterov else p - 0.1 * v
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got["VelocityOut"], v, rtol=1e-5,
+                                   atol=1e-6)
+        p, v = got["ParamOut"], got["VelocityOut"]
+
+
+def test_adagrad():
+    p, m = _p(), np.zeros((4, 3), np.float32)
+    eps = 1e-6
+    for g in _steps():
+        got = run_op("adagrad", {"Param": p, "Grad": g, "Moment": m,
+                                 "LearningRate": LR}, {"epsilon": eps})
+        m = m + g * g
+        p = p - 0.1 * g / (np.sqrt(m) + eps)
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-5, atol=1e-6)
+        p, m = got["ParamOut"], got["MomentOut"]
+
+
+def test_adam_matches_textbook_bias_correction():
+    beta1, beta2, eps = 0.9, 0.999, 1e-8
+    p = _p()
+    m1 = np.zeros((4, 3), np.float32)
+    m2 = np.zeros((4, 3), np.float32)
+    b1p = np.array([1.0], np.float32)  # beta^(t-1) convention, t starts 1
+    b2p = np.array([1.0], np.float32)
+    for t, g in enumerate(_steps(4), start=1):
+        got = run_op("adam", {
+            "Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+            "LearningRate": LR, "Beta1Pow": b1p, "Beta2Pow": b2p,
+        }, {"beta1": beta1, "beta2": beta2, "epsilon": eps})
+        m1 = beta1 * m1 + (1 - beta1) * g
+        m2 = beta2 * m2 + (1 - beta2) * g * g
+        lr_t = 0.1 * np.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+        p = p - lr_t * m1 / (np.sqrt(m2) + eps)
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got["Beta1PowOut"],
+                                   [beta1 ** t], rtol=1e-5)
+        p, m1, m2 = got["ParamOut"], got["Moment1Out"], got["Moment2Out"]
+        b1p, b2p = got["Beta1PowOut"], got["Beta2PowOut"]
+
+
+def test_adamax():
+    beta1, beta2, eps = 0.9, 0.999, 1e-8
+    p = _p()
+    m = np.zeros((4, 3), np.float32)
+    u = np.zeros((4, 3), np.float32)
+    b1p = np.array([1.0], np.float32)
+    for t, g in enumerate(_steps(), start=1):
+        got = run_op("adamax", {
+            "Param": p, "Grad": g, "Moment": m, "InfNorm": u,
+            "LearningRate": LR, "Beta1Pow": b1p,
+        }, {"beta1": beta1, "beta2": beta2, "epsilon": eps})
+        m = beta1 * m + (1 - beta1) * g
+        u = np.maximum(beta2 * u, np.abs(g))
+        p = p - (0.1 / (1 - beta1 ** t)) * m / (u + eps)
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-4, atol=1e-5)
+        p, m, u, b1p = (got["ParamOut"], got["MomentOut"],
+                        got["InfNormOut"], got["Beta1PowOut"])
+
+
+def test_adadelta():
+    rho, eps = 0.95, 1e-6
+    p = _p()
+    asg = np.zeros((4, 3), np.float32)
+    asu = np.zeros((4, 3), np.float32)
+    for g in _steps():
+        got = run_op("adadelta", {
+            "Param": p, "Grad": g, "AvgSquaredGrad": asg,
+            "AvgSquaredUpdate": asu}, {"rho": rho, "epsilon": eps})
+        asg = rho * asg + (1 - rho) * g * g
+        upd = -np.sqrt((asu + eps) / (asg + eps)) * g
+        asu = rho * asu + (1 - rho) * upd * upd
+        p = p + upd
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-4, atol=1e-5)
+        p, asg, asu = (got["ParamOut"], got["AvgSquaredGradOut"],
+                       got["AvgSquaredUpdateOut"])
+
+
+def test_decayed_adagrad():
+    decay, eps = 0.95, 1e-6
+    p, m = _p(), np.zeros((4, 3), np.float32)
+    for g in _steps():
+        got = run_op("decayed_adagrad",
+                     {"Param": p, "Grad": g, "Moment": m,
+                      "LearningRate": LR},
+                     {"decay": decay, "epsilon": eps})
+        m = decay * m + (1 - decay) * g * g
+        p = p - 0.1 * g / (np.sqrt(m) + eps)
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-4, atol=1e-5)
+        p, m = got["ParamOut"], got["MomentOut"]
+
+
+def test_rmsprop():
+    eps, decay, mom_c = 1e-10, 0.9, 0.6
+    p = _p()
+    ms = np.zeros((4, 3), np.float32)
+    mom = np.zeros((4, 3), np.float32)
+    for g in _steps():
+        got = run_op("rmsprop", {
+            "Param": p, "Grad": g, "MeanSquare": ms, "Moment": mom,
+            "LearningRate": LR},
+            {"epsilon": eps, "decay": decay, "momentum": mom_c})
+        ms = decay * ms + (1 - decay) * g * g
+        mom = mom_c * mom + 0.1 * g / np.sqrt(ms + eps)
+        p = p - mom
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-4, atol=1e-5)
+        p, ms, mom = got["ParamOut"], got["MeanSquareOut"], got["MomentOut"]
+
+
+def test_ftrl():
+    l1, l2, lr_power = 0.1, 0.2, -0.5
+    p = _p()
+    sq = np.zeros((4, 3), np.float32)
+    lin = np.zeros((4, 3), np.float32)
+    for g in _steps():
+        got = run_op("ftrl", {
+            "Param": p, "Grad": g, "SquaredAccumulator": sq,
+            "LinearAccumulator": lin, "LearningRate": LR},
+            {"l1": l1, "l2": l2, "lr_power": lr_power})
+        new_sq = sq + g * g
+        sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / 0.1
+        new_lin = lin + g - sigma * p
+        denom = np.sqrt(new_sq) / 0.1 + 2 * l2
+        p = (np.clip(new_lin, -l1, l1) - new_lin) / denom
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-4, atol=1e-5)
+        sq, lin = got["SquaredAccumOut"], got["LinearAccumOut"]
+        p = got["ParamOut"]
+
+
+def test_proximal_gd():
+    l1, l2 = 0.05, 0.1
+    p = _p()
+    for g in _steps():
+        got = run_op("proximal_gd",
+                     {"Param": p, "Grad": g, "LearningRate": LR},
+                     {"l1": l1, "l2": l2})
+        prox = p - 0.1 * g
+        p = (np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0.0)
+             / (1.0 + 0.1 * l2))
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-4, atol=1e-5)
+        p = got["ParamOut"]
+
+
+def test_proximal_adagrad():
+    l1, l2 = 0.05, 0.1
+    p, m = _p(), np.zeros((4, 3), np.float32)
+    for g in _steps():
+        got = run_op("proximal_adagrad",
+                     {"Param": p, "Grad": g, "Moment": m,
+                      "LearningRate": LR}, {"l1": l1, "l2": l2})
+        m = m + g * g
+        lr = 0.1 / np.sqrt(m)
+        prox = p - lr * g
+        p = (np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+        np.testing.assert_allclose(got["ParamOut"], p, rtol=1e-4, atol=1e-5)
+        p, m = got["ParamOut"], got["MomentOut"]
