@@ -137,11 +137,15 @@ def _apply_act(out, a):
     return getattr(layers, a)(out)
 
 
-def fc_layer(input, size, act=None, param_attr=None, bias_attr=None, **_):
+def fc_layer(input, size, act=None, param_attr=None, bias_attr=None,
+             name=None, **_):
     # layers.fc handles list inputs natively (per-input weights, summed
     # matmuls, ONE bias) — exactly the v1 multi-input fc semantics.
     out = layers.fc(input, size, param_attr=param_attr, bias_attr=bias_attr)
-    return _apply_act(out, _act(act, "tanh"))  # v1 default act is tanh
+    out = _apply_act(out, _act(act, "tanh"))  # v1 default act is tanh
+    from .v1_ext import _register_name
+
+    return _register_name(out, name)
 
 
 def embedding_layer(input, size, param_attr=None, **_):
@@ -200,18 +204,18 @@ def concat_layer(input, act=None, **_):
     return _apply_act(_tensor.concat(list(input), axis=1), _act(act))
 
 
-def addto_layer(input, act=None, bias_attr=None, **_):
+def addto_layer(input, act=None, bias_attr=None, name=None, **_):
     inputs = input if isinstance(input, (list, tuple)) else [input]
     out = inputs[0]
     for x in inputs[1:]:
         out = out + x
-    return _apply_act(out, _act(act))
+    out = _apply_act(out, _act(act))
+    from .v1_ext import _register_name
+
+    return _register_name(out, name)
 
 
-def mixed_layer(size=None, input=None, act=None, bias_attr=None, **_):
-    """v1 mixed_layer with full_matrix_projection inputs == sum of fc."""
-    return fc_layer(input=input, size=size, act=act or IdentityActivation(),
-                    bias_attr=bias_attr)
+# mixed_layer: see v1_ext.py (projection/operator form)
 
 
 def lstmemory(input, size=None, reverse=False, act=None, **_):
@@ -440,3 +444,13 @@ def outputs(*layers_):
     """v1 config bookkeeping (declares fetch targets).  Returns the list;
     fetch targets are whatever you pass to Executor.run(fetch_list=...)."""
     return list(layers_)
+
+
+# ------------------------------------------------- long-tail surface
+# (projections, recurrent_group, the remaining *_layer functions,
+# activations/attrs/poolings/optimizers/evaluators/networks — see
+# v1_ext.py; imported last so the helpers above exist at class-build time)
+from .v1_ext import *  # noqa: F401,F403,E402
+from . import v1_ext as _v1_ext  # noqa: E402
+
+__all__ = list(dict.fromkeys(__all__ + _v1_ext.__all__))

@@ -197,3 +197,89 @@ def detection_output(Loc, Conf, PriorBox, background_label=0,
 
     out = jax.vmap(per_image)(boxes, Conf)
     return {"Out": out}
+
+
+@register_op("multibox_loss")
+def multibox_loss(Loc, Conf, PriorBox, GtBox, GtLabel,
+                  overlap_threshold=0.5, neg_pos_ratio=3.0,
+                  background_label=0, **_):
+    """SSD training loss (reference
+    ``paddle/gserver/layers/MultiBoxLossLayer.cpp:1``): match priors to
+    ground truth by IoU, smooth-L1 on the matched location offsets,
+    softmax cross-entropy on class confidences with hard negative mining
+    (negatives ranked by loss, kept up to neg_pos_ratio x positives).
+
+    Loc [b, P, 4] (center-size offsets), Conf [b, P, C],
+    PriorBox [P, 4] or [2, P, 4] (boxes + variances),
+    GtBox [b, G, 4] corner form, GtLabel [b, G] int (< 0 = padding).
+    Returns Loss [b, 1] (per-image loc+conf loss, normalized by positives).
+    """
+    prior, var = PriorBox, None
+    if PriorBox.ndim == 3:
+        prior, var = PriorBox[0], PriorBox[1]
+    if var is None:
+        var = jnp.full_like(prior, 0.1).at[:, 2:].set(0.2)
+    b, p, _4 = Loc.shape
+    g = GtBox.shape[1]
+    c = Conf.shape[-1]
+
+    valid_gt = GtLabel >= 0                                   # [b, G]
+    # IoU prior x gt
+    ax1, ay1, ax2, ay2 = [prior[:, i] for i in range(4)]
+    area_p = (ax2 - ax1) * (ay2 - ay1)                        # [P]
+    bx1, by1, bx2, by2 = [GtBox[..., i] for i in range(4)]    # [b, G]
+    ix1 = jnp.maximum(ax1[None, :, None], bx1[:, None, :])
+    iy1 = jnp.maximum(ay1[None, :, None], by1[:, None, :])
+    ix2 = jnp.minimum(ax2[None, :, None], bx2[:, None, :])
+    iy2 = jnp.minimum(ay2[None, :, None], by2[:, None, :])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)   # [b, P, G]
+    area_g = ((bx2 - bx1) * (by2 - by1))[:, None, :]
+    iou = inter / jnp.maximum(area_p[None, :, None] + area_g - inter, 1e-10)
+    iou = jnp.where(valid_gt[:, None, :], iou, -1.0)
+
+    best_gt = jnp.argmax(iou, axis=2)                         # [b, P]
+    best_iou = jnp.max(iou, axis=2)
+    matched = best_iou >= overlap_threshold                   # [b, P]
+    n_pos = jnp.sum(matched, axis=1)                          # [b]
+
+    # encode matched gt as center-size offsets wrt the prior (SSD encode)
+    mb = jnp.take_along_axis(GtBox, best_gt[..., None], axis=1)  # [b,P,4]
+    pw, ph = ax2 - ax1, ay2 - ay1
+    pcx, pcy = (ax1 + ax2) / 2, (ay1 + ay2) / 2
+    gcx = (mb[..., 0] + mb[..., 2]) / 2
+    gcy = (mb[..., 1] + mb[..., 3]) / 2
+    gw = jnp.maximum(mb[..., 2] - mb[..., 0], 1e-10)
+    gh = jnp.maximum(mb[..., 3] - mb[..., 1], 1e-10)
+    t = jnp.stack([
+        (gcx - pcx[None]) / pw[None] / var[:, 0][None],
+        (gcy - pcy[None]) / ph[None] / var[:, 1][None],
+        jnp.log(gw / pw[None]) / var[:, 2][None],
+        jnp.log(gh / ph[None]) / var[:, 3][None],
+    ], axis=-1)                                               # [b, P, 4]
+    diff = Loc - jax.lax.stop_gradient(t)
+    ad = jnp.abs(diff)
+    smooth_l1 = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(-1)
+    loc_loss = jnp.sum(jnp.where(matched, smooth_l1, 0.0), axis=1)
+
+    # conf loss: softmax CE against matched label (background if unmatched)
+    tgt = jnp.where(
+        matched,
+        jnp.take_along_axis(GtLabel, best_gt, axis=1),
+        background_label,
+    )                                                         # [b, P]
+    logp = jax.nn.log_softmax(Conf, axis=-1)
+    ce = -jnp.take_along_axis(
+        logp, tgt[..., None].astype(jnp.int32), axis=2)[..., 0]  # [b, P]
+
+    # hard negative mining: keep top (ratio * n_pos) unmatched by CE
+    neg_ce = jnp.where(matched, -jnp.inf, ce)
+    order = jnp.argsort(-neg_ce, axis=1)
+    rank = jnp.argsort(order, axis=1)                         # rank of each
+    n_neg = jnp.minimum((neg_pos_ratio * n_pos).astype(jnp.int32),
+                        p - n_pos)
+    keep_neg = jnp.logical_and(~matched, rank < n_neg[:, None])
+    conf_loss = jnp.sum(jnp.where(jnp.logical_or(matched, keep_neg),
+                                  ce, 0.0), axis=1)
+
+    denom = jnp.maximum(n_pos.astype(Loc.dtype), 1.0)
+    return {"Loss": ((loc_loss + conf_loss) / denom)[:, None]}

@@ -421,3 +421,35 @@ def row_conv(X, Filter, Length=None, **_):
         mask = (jnp.arange(t)[None, :] < Length[:, None])[..., None]
         out = jnp.where(mask, out, 0.0)
     return {"Out": out}
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(X, out_h=0, out_w=0, **_):
+    """Bilinear upsampling, align-corners convention of the reference
+    (``paddle/gserver/layers/BilinearInterpLayer.cpp:1``: ratio =
+    (in-1)/(out-1)).  X [N, C, H, W] -> [N, C, out_h, out_w]."""
+    n, c, h, w = X.shape
+    oh, ow = int(out_h), int(out_w)
+
+    def axis_coords(in_dim, out_dim):
+        if out_dim == 1 or in_dim == 1:
+            return (jnp.zeros((out_dim,), jnp.float32),
+                    jnp.zeros((out_dim,), jnp.int32),
+                    jnp.zeros((out_dim,), jnp.int32))
+        ratio = (in_dim - 1.0) / (out_dim - 1.0)
+        src = jnp.arange(out_dim, dtype=jnp.float32) * ratio
+        lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_dim - 1)
+        hi = jnp.clip(lo + 1, 0, in_dim - 1)
+        return src - lo.astype(jnp.float32), lo, hi
+
+    fy, y0, y1 = axis_coords(h, oh)
+    fx, x0, x1 = axis_coords(w, ow)
+    tl = X[:, :, y0][:, :, :, x0]
+    tr = X[:, :, y0][:, :, :, x1]
+    bl = X[:, :, y1][:, :, :, x0]
+    br = X[:, :, y1][:, :, :, x1]
+    fy = fy.reshape(1, 1, oh, 1).astype(X.dtype)
+    fx = fx.reshape(1, 1, 1, ow).astype(X.dtype)
+    top = tl * (1 - fx) + tr * fx
+    bot = bl * (1 - fx) + br * fx
+    return {"Out": top * (1 - fy) + bot * fy}

@@ -79,3 +79,14 @@ def random_crop(X, shape=(), _key=None, **_):
         )
     out = jax.lax.dynamic_slice(X, [jnp.asarray(s) for s in starts], out_shape)
     return {"Out": out}
+
+
+@register_op("sampling_id", stateful_rng=True, nondiff=True)
+def sampling_id(X, _key=None, **_):
+    """Sample one id per row from the row's probability distribution
+    (``paddle/gserver/layers/SamplingIdLayer.cpp:1``).  X [b, k] of
+    probabilities (rows need not be exactly normalized)."""
+    key = _key if _key is not None else jax.random.PRNGKey(0)
+    logits = jnp.log(jnp.maximum(X, 1e-20))
+    ids = jax.random.categorical(key, logits, axis=-1)
+    return {"Out": ids.astype(jnp.int32)}

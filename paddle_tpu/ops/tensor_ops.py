@@ -102,6 +102,9 @@ def pad(X, paddings=(), pad_value=0.0, **_):
 def crop(X, Y=None, offsets=(), shape=(), **_):
     tgt = Y.shape if Y is not None else tuple(shape)
     off = list(offsets) if offsets else [0] * X.ndim
+    # -1 extends to the end of the dim (build-time-unknown batch axes)
+    tgt = tuple(X.shape[i] - off[i] if s == -1 else s
+                for i, s in enumerate(tgt))
     slices = tuple(slice(o, o + s) for o, s in zip(off, tgt))
     return {"Out": X[slices]}
 
@@ -180,3 +183,23 @@ def isfinite(X, **_):
     for x in xs:
         ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x.astype(jnp.float32))))
     return {"Out": ok}
+
+
+@register_op("scale_sub_region")
+def scale_sub_region(X, Indices, value=1.0, **_):
+    """Scale the values inside a per-sample sub-region of a [N, C, H, W]
+    feature map (reference ``paddle/gserver/layers/ScaleSubRegionLayer.cpp:1``).
+    Indices [N, 6] int = (c1, c2, h1, h2, w1, w2), 1-based inclusive like
+    the reference config."""
+    n, c, h, w = X.shape
+    idx = Indices.astype(jnp.int32)
+
+    def axis_mask(lo, hi, dim):
+        r = jnp.arange(dim)[None, :]
+        return jnp.logical_and(r >= lo[:, None] - 1, r <= hi[:, None] - 1)
+
+    mc = axis_mask(idx[:, 0], idx[:, 1], c)[:, :, None, None]
+    mh = axis_mask(idx[:, 2], idx[:, 3], h)[:, None, :, None]
+    mw = axis_mask(idx[:, 4], idx[:, 5], w)[:, None, None, :]
+    region = jnp.logical_and(jnp.logical_and(mc, mh), mw)
+    return {"Out": jnp.where(region, X * value, X)}
