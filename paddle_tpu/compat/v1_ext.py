@@ -569,16 +569,17 @@ def seq_concat_layer(a, b, **_):
 
 
 def seq_slice_layer(input, starts, ends, **_):
-    from ..layers.layer_helper import seq_length
-
+    """v1 contract: [starts, ends) positions -> lengths = ends - starts
+    for the sequence_slice op (which takes Offset + SeqLength)."""
     helper = LayerHelper("seq_slice")
+    lengths = layers.elementwise_sub(ends, starts)
     out = helper.create_tmp_variable(input.dtype, list(input.shape))
     ln = helper.create_tmp_variable("int32", [input.shape[0]],
                                     stop_gradient=True)
     helper.append_op(
         type="sequence_slice",
         inputs={"X": [input.name], "Offset": [starts.name],
-                "SeqLength": [ends.name]},
+                "SeqLength": [lengths.name]},
         outputs={"Out": [out.name], "OutLength": [ln.name]},
     )
     return out
@@ -708,20 +709,21 @@ printer_layer = print_layer
 
 def priorbox_layer(input, image, min_size, max_size=(), aspect_ratio=(),
                    variance=(0.1, 0.1, 0.2, 0.2), **_):
-    helper = LayerHelper("prior_box")
-    out = helper.create_tmp_variable(input.dtype, [-1, 4],
-                                     stop_gradient=True)
-    var_out = helper.create_tmp_variable(input.dtype, [-1, 4],
-                                         stop_gradient=True)
-    helper.append_op(
-        type="prior_box",
-        inputs={"Input": [input.name], "Image": [image.name]},
-        outputs={"Boxes": [out.name], "Variances": [var_out.name]},
-        attrs={"min_sizes": tuple(min_size), "max_sizes": tuple(max_size),
-               "aspect_ratios": tuple(aspect_ratio) or (1.0,),
-               "variances": tuple(variance)},
-    )
-    return out
+    """Prior boxes flattened to the [2, P, 4] boxes+variances form every
+    downstream consumer (multibox_loss_layer / detection_output_layer)
+    expects."""
+    from ..layers import detection as _det
+
+    boxes, var = _det.prior_box(
+        input, image, min_sizes=list(min_size),
+        max_sizes=list(max_size or []),
+        aspect_ratios=list(aspect_ratio) or [1.0],
+        variances=list(variance))
+    n = boxes.shape[0] * boxes.shape[1] * boxes.shape[2]
+    return _tensor.concat([
+        _tensor.reshape(_tensor.reshape(boxes, [n, 4]), [1, n, 4]),
+        _tensor.reshape(_tensor.reshape(var, [n, 4]), [1, n, 4]),
+    ], axis=0)
 
 
 def cross_channel_norm_layer(input, **_):
@@ -731,40 +733,25 @@ def cross_channel_norm_layer(input, **_):
 def multibox_loss_layer(input_loc, input_conf, priorbox, label_box,
                         label_cls, overlap_threshold=0.5,
                         neg_pos_ratio=3.0, background_id=0, **_):
-    helper = LayerHelper("multibox_loss")
-    out = helper.create_tmp_variable(input_loc.dtype,
-                                     [input_loc.shape[0], 1])
-    helper.append_op(
-        type="multibox_loss",
-        inputs={"Loc": [input_loc.name], "Conf": [input_conf.name],
-                "PriorBox": [priorbox.name], "GtBox": [label_box.name],
-                "GtLabel": [label_cls.name]},
-        outputs={"Loss": [out.name]},
-        attrs={"overlap_threshold": overlap_threshold,
-               "neg_pos_ratio": neg_pos_ratio,
-               "background_label": background_id},
-    )
-    return layers.mean(out)
+    from ..layers import detection as _det
+
+    loss = _det.multibox_loss(
+        input_loc, input_conf, priorbox, label_box, label_cls,
+        overlap_threshold=overlap_threshold, neg_pos_ratio=neg_pos_ratio,
+        background_label=background_id)
+    return layers.mean(loss)
 
 
 def detection_output_layer(input_loc, input_conf, priorbox,
                            nms_threshold=0.45, nms_top_k=400,
                            keep_top_k=200, confidence_threshold=0.01,
                            background_id=0, **_):
-    helper = LayerHelper("detection_output")
-    out = helper.create_tmp_variable(input_loc.dtype, [-1, keep_top_k, 6],
-                                     stop_gradient=True)
-    helper.append_op(
-        type="detection_output",
-        inputs={"Loc": [input_loc.name], "Conf": [input_conf.name],
-                "PriorBox": [priorbox.name]},
-        outputs={"Out": [out.name]},
-        attrs={"nms_threshold": nms_threshold, "nms_top_k": nms_top_k,
-               "keep_top_k": keep_top_k,
-               "score_threshold": confidence_threshold,
-               "background_label": background_id},
-    )
-    return out
+    from ..layers import detection as _det
+
+    return _det.detection_output(
+        input_loc, input_conf, priorbox, background_label=background_id,
+        nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, score_threshold=confidence_threshold)
 
 
 def roi_pool_layer(input, rois, pooled_width, pooled_height,
@@ -838,7 +825,16 @@ def gated_unit_layer(input, size, act=None, gate_param_attr=None,
 
 
 def crop_layer(input, offset, shape=None, axis=2, **_):
-    return _tensor.crop(input, shape=shape, offsets=offset)
+    """v1 crop: offset/shape apply FROM `axis` (default 2 = spatial dims);
+    leading dims pass through untouched."""
+    nd = len(input.shape)
+    full_off = [0] * axis + list(offset)
+    full_shape = [-1] * axis + list(
+        shape if shape is not None
+        else [input.shape[axis + i] - o for i, o in enumerate(offset)])
+    full_off += [0] * (nd - len(full_off))
+    full_shape += [-1] * (nd - len(full_shape))
+    return _tensor.crop(input, shape=full_shape, offsets=full_off)
 
 
 def clip_layer(input, min, max, **_):

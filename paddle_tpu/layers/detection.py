@@ -5,7 +5,7 @@ detection_output)."""
 from .layer_helper import LayerHelper
 
 __all__ = ["prior_box", "iou_similarity", "bipartite_match", "roi_pool",
-           "detection_output"]
+           "detection_output", "multibox_loss"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
@@ -100,5 +100,28 @@ def detection_output(loc, scores, prior_box, background_label=0,
             "keep_top_k": keep_top_k,
             "score_threshold": score_threshold,
         },
+    )
+    return out
+
+
+def multibox_loss(loc, conf, prior_box, gt_box, gt_label,
+                  overlap_threshold=0.5, neg_pos_ratio=3.0,
+                  background_label=0, name=None):
+    """SSD training loss (reference gserver MultiBoxLossLayer.cpp): IoU
+    matching, smooth-L1 on matched location offsets, softmax CE on
+    confidences with hard negative mining.  loc [b, P, 4], conf [b, P, C],
+    prior_box [P, 4] or [2, P, 4], gt_box [b, G, 4], gt_label [b, G]
+    (< 0 = padding).  Returns the per-image loss [b, 1]."""
+    helper = LayerHelper("multibox_loss", name=name)
+    out = helper.create_tmp_variable(loc.dtype, [loc.shape[0], 1])
+    helper.append_op(
+        type="multibox_loss",
+        inputs={"Loc": [loc.name], "Conf": [conf.name],
+                "PriorBox": [prior_box.name], "GtBox": [gt_box.name],
+                "GtLabel": [gt_label.name]},
+        outputs={"Loss": [out.name]},
+        attrs={"overlap_threshold": overlap_threshold,
+               "neg_pos_ratio": neg_pos_ratio,
+               "background_label": background_label},
     )
     return out

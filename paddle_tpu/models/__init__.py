@@ -17,10 +17,12 @@ from . import fit_a_line
 from . import label_semantic_roles
 from . import recommender
 from . import transformer
+from . import ssd
 
 __all__ = [
     "lenet", "resnet", "vgg", "alexnet", "googlenet", "smallnet",
     "text_classification", "seq2seq", "deep_speech2", "ctr_dnn",
     "word2vec", "fit_a_line", "label_semantic_roles", "recommender",
     "transformer",
+    "ssd",
 ]

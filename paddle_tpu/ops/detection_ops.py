@@ -151,7 +151,10 @@ def detection_output(Loc, Conf, PriorBox, background_label=0,
     c = Conf.shape[-1]
     loc = Loc.reshape(b, p, 4)
     if var is None:
-        var = jnp.full((p, 4), 0.1, jnp.float32)
+        # SAME fallback as multibox_loss: (0.1, 0.1, 0.2, 0.2) — training
+        # and decoding must scale w/h offsets identically
+        var = jnp.tile(jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32),
+                       (p, 1))
     # decode center-size offsets
     pw = prior[:, 2] - prior[:, 0]
     ph = prior[:, 3] - prior[:, 1]
@@ -240,6 +243,17 @@ def multibox_loss(Loc, Conf, PriorBox, GtBox, GtLabel,
     best_gt = jnp.argmax(iou, axis=2)                         # [b, P]
     best_iou = jnp.max(iou, axis=2)
     matched = best_iou >= overlap_threshold                   # [b, P]
+    # bipartite stage (reference MultiBoxLossLayer matchBBox): every valid
+    # gt claims its best-overlap prior even below the threshold, so no
+    # ground truth is left without a positive / loc signal
+    bidx = jnp.arange(b)[:, None]
+    gidx = jnp.broadcast_to(jnp.arange(g)[None, :], (b, g))
+    best_prior = jnp.argmax(iou, axis=1)                      # [b, G]
+    force = jnp.zeros((b, p), jnp.bool_).at[bidx, best_prior].set(valid_gt)
+    forced_gt = jnp.zeros((b, p), best_gt.dtype).at[
+        bidx, best_prior].set(jnp.where(valid_gt, gidx, 0))
+    best_gt = jnp.where(force, forced_gt, best_gt)
+    matched = jnp.logical_or(matched, force)
     n_pos = jnp.sum(matched, axis=1)                          # [b]
 
     # encode matched gt as center-size offsets wrt the prior (SSD encode)

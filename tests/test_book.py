@@ -208,3 +208,33 @@ def test_deep_speech2_ctc():
     feed = {"audio": audio, "audio@LENGTH": audio_len,
             "transcript": label, "transcript@LENGTH": label_len}
     train_steps(outs, feed, steps=4)
+
+
+def test_ssd_detection():
+    """SSD family: multi-scale prior boxes + multibox_loss training, then
+    detection_output inference recovers a planted box (the v1 SSD config
+    family — MultiBoxLossLayer / DetectionOutputLayer / PriorBox)."""
+    from paddle_tpu.models import ssd
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        model = ssd.build(num_classes=4, image_shape=(3, 64, 64), max_gt=8)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    imgs, gt_box, gt_label = ssd.synthetic_batch(16)
+    feed = {"img": imgs, "gt_box": gt_box, "gt_label": gt_label}
+    losses = [
+        float(np.asarray(exe.run(main, feed=feed,
+                                 fetch_list=[model["avg_cost"]],
+                                 scope=scope)[0]).ravel()[0])
+        for _ in range(12)
+    ]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] * 0.8, losses[::4]
+
+    # the same program carries the inference head (nondiff branch)
+    (dets,) = exe.run(main, feed=feed,
+                      fetch_list=[model["detections"]], scope=scope)
+    dets = np.asarray(dets)
+    assert dets.shape[0] == 16 and dets.shape[-1] == 6

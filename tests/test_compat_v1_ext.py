@@ -156,8 +156,6 @@ def test_lstm_and_gru_step_layers_in_group():
         def lstm_step(x_t):
             cell = v1.memory(name="c", size=2)
             h = v1.lstm_step_layer(x_t, cell, size=2, name="h")
-            v1._register_name(v1.get_output_layer(h, "state"), "c") \
-                if False else None
             # the cell is h's auxiliary output
             from paddle_tpu.compat.v1_ext import _register_name
             _register_name(v1.get_output_layer(h, "state"), "c")
@@ -428,3 +426,34 @@ def test_mixed_layer_creates_default_bias():
     with pt.program_guard(main, startup):
         build()
     assert any(".b" in p.name for p in main.global_block().all_parameters())
+
+
+def test_v1_ssd_config_path():
+    """priorbox_layer -> multibox_loss_layer -> detection_output_layer:
+    the ported v1 SSD config wiring runs end-to-end (regression: the
+    prior output was 4-D and broke every consumer)."""
+    imgs = rng.rand(2, 3, 16, 16).astype(np.float32)
+    gt_box = np.zeros((2, 2, 4), np.float32)
+    gt_box[:, 0] = (0.2, 0.2, 0.5, 0.5)
+    gt_label = np.array([[1, -1], [1, -1]], np.int64)
+
+    def build():
+        img = pt.layers.data("img", shape=[3, 16, 16], dtype="float32")
+        gb = pt.layers.data("gb", shape=[2, 4], dtype="float32")
+        gl = pt.layers.data("gl", shape=[2], dtype="int64")
+        feat = pt.layers.conv2d(img, 8, 3, padding=1, act="relu")
+        feat = pt.layers.pool2d(feat, pool_size=4, pool_stride=4)
+        pb = v1.priorbox_layer(feat, img, min_size=[4.0], max_size=[8.0])
+        p = pb.shape[1]
+        loc = pt.layers.conv2d(feat, 2 * 4, 3, padding=1)
+        conf = pt.layers.conv2d(feat, 2 * 3, 3, padding=1)
+        from paddle_tpu.layers import tensor as T
+
+        loc = T.reshape(T.transpose(loc, [0, 2, 3, 1]), [2, p, 4])
+        conf = T.reshape(T.transpose(conf, [0, 2, 3, 1]), [2, p, 3])
+        loss = v1.multibox_loss_layer(loc, conf, pb, gb, gl)
+        dets = v1.detection_output_layer(loc, conf, pb)
+        return loss, dets
+
+    loss, dets = run_cfg(build, {"img": imgs, "gb": gt_box, "gl": gt_label})
+    assert np.isfinite(loss).all() and dets.shape[-1] == 6
