@@ -10,10 +10,13 @@ custom VJP with recompute backward).
 Layout: q [b, t_q, h, d], k/v [b, t_k, h, d] (same as parallel.ring_attention,
 whose per-device inner block this kernel accelerates).
 
-Forward: Pallas kernel, one grid cell per (batch*head, q-block); inner
-fori_loop streams K/V blocks through VMEM with online softmax.
-Backward: custom_vjp — blockwise recompute in plain JAX (XLA fuses the
-einsums onto the MXU; memory stays O(t * block)).
+Forward: Pallas kernel, grid (batch*head, q-blocks, k-blocks) with the
+k axis innermost; online-softmax state carried in VMEM scratch; causal
+k-blocks above the diagonal are skipped.  Backward: custom_vjp into two
+Pallas kernels — dq (q-major grid) and dk/dv (k-major grid) — recomputing
+p from the saved lane-replicated lse, also with causal block skip.
+delta = rowsum(do*o) is computed inside the kernels.  HBM residuals are
+O(t) rows (lse carries 128 f32 lanes/row); VMEM stays O(block^2).
 """
 
 import functools
@@ -35,130 +38,290 @@ def _pick_block(t, cap):
     return b
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
-                      causal, block_q, block_k, t_k):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                      acc_scr, *, sm_scale, causal, block_q, block_k, nk):
+    """One (batch*head, q-block, k-block) grid cell.  The k-block axis is
+    the INNERMOST grid dimension (TPU grids run sequentially), so the
+    online-softmax state lives in VMEM scratch carried across k steps —
+    VMEM holds only O(block_q*d + block_k*d), never the full K/V (a
+    whole-K/V block spec OOMs scoped vmem at t ~ 16k)."""
     import jax.experimental.pallas as pl
 
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    bq, d = q.shape
     j = pl.program_id(1)
-    q_pos = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    kb = pl.program_id(2)
 
-    nk = t_k // block_k
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    if causal:
+        # causal block skip: k blocks strictly above the diagonal touch
+        # no unmasked entries — skip their compute entirely (halves the
+        # causal forward's work).  Clamp to nk-1: cross-attention with
+        # t_q > t_k has q blocks whose diagonal lies beyond the last k
+        # block, and the finalize step must still fire for them.
+        last_kb = jnp.minimum(((j + 1) * block_q - 1) // block_k, nk - 1)
+        needed = kb <= last_kb
+    else:
+        last_kb = nk - 1
+        needed = None
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        bq = q.shape[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale  # [bq, bk]
         if causal:
+            q_pos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1
-            )
+                jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m2 = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m2)
-        p = jnp.exp(s - m2[:, None])
-        l2 = l * alpha + jnp.sum(p, axis=-1)
-        acc2 = acc * alpha[:, None] + jax.lax.dot_general(
+
+        # m/l are lane-replicated [bq, 128] (Mosaic min lane tile)
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m2 = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m2)
+        p = jnp.exp(s - m2[:, :1])
+        l2 = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc2 = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m2, l2, acc2
+        m_scr[...] = m2
+        l_scr[...] = l2
+        acc_scr[...] = acc2
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse is replicated across a 128-lane trailing dim: Mosaic requires the
-    # last two block dims be (8k, 128m) tiles, so a [bq] vector per grid
-    # cell is stored as [bq, 128] (the official TPU flash kernels do the
-    # same); the wrapper slices lane 0 back out.
-    lse_ref[0] = jnp.broadcast_to(
-        (m + jnp.log(l_safe))[:, None], (bq, LSE_LANES)
-    )
+    if needed is None:
+        _block()
+    else:
+        pl.when(needed)(_block)
+
+    @pl.when(kb == last_kb)
+    def _finalize():
+        l_fin = l_scr[...]
+        l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     block_q = _pick_block(t_q, block_q)
     block_k = _pick_block(t_k, block_k)
+    nk = t_k // block_k
 
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, t_k=t_k,
+        block_q=block_q, block_k=block_k, nk=nk,
     )
+    scratch = [
+        pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # m
+        pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # l
+        pltpu.VMEM((block_q, d), jnp.float32),          # acc
+    ]
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, t_q // block_q),
+        grid=(bh, t_q // block_q, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t_k, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j, kb: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
             jax.ShapeDtypeStruct((bh, t_q, LSE_LANES), jnp.float32),
         ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
-    return o, lse[:, :, 0]
+    # lse stays lane-replicated [bh, t_q, LSE_LANES] — it is the backward
+    # kernels' residual in exactly this layout (512 B/row f32; ~1 GiB at
+    # the 64k benchmark config, the price of Mosaic-friendly tiling)
+    return o, lse
 
 
-def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_k):
-    """Blockwise backward from saved lse (plain JAX; scan over K/V blocks
-    keeps memory O(t*block) while XLA runs the einsums on the MXU)."""
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                   dq_scr, delta_scr, *, sm_scale, causal, block_q,
+                   block_k, nk):
+    """dq: grid (bh, q-blocks, k-blocks), k innermost; accumulate in VMEM.
+    delta = rowsum(do*o) is computed here (kb==0) instead of being passed
+    as a lane-replicated HBM array."""
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr[...])
+        d_row = jnp.sum(
+            do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True)
+        delta_scr[...] = jnp.broadcast_to(d_row, delta_scr.shape)
+
+    if causal:
+        # clamped like the forward: cross-attention t_q > t_k must still
+        # finalize the q blocks past the last k block
+        last_kb = jnp.minimum(((j + 1) * block_q - 1) // block_k, nk - 1)
+    else:
+        last_kb = nk - 1
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]      # [bq, LSE_LANES] lane-replicated
+        delta = delta_scr[...]
+        bq = q.shape[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, :1])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, :1]) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(kb <= last_kb)(_block)
+    else:
+        _block()
+
+    @pl.when(kb == last_kb)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, block_k, nq):
+    """dk/dv: grid (bh, k-blocks, q-blocks), q innermost."""
+    import jax.experimental.pallas as pl
+
+    kb = pl.program_id(1)
+    jq = pl.program_id(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    def _block():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)
+        bq = q.shape[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = jq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, :1])
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, :1]) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # q block jq touches k block kb iff its last row is at/below the
+        # block diagonal: (jq+1)*bq - 1 >= kb*bk
+        pl.when(jq >= (kb * block_k) // block_q)(_block)
+    else:
+        _block()
+
+    @pl.when(jq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+               interpret):
+    """Pallas backward: dq kernel (q-major) + dk/dv kernel (k-major),
+    both with causal block skip; O(block^2) VMEM, O(t) HBM residuals."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     bh, t_q, d = q.shape
     t_k = k.shape[1]
+    block_q = _pick_block(t_q, block_q)
     block_k = _pick_block(t_k, block_k)
+    nq = t_q // block_q
     nk = t_k // block_k
 
-    qf = q.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [bh, tq]
-    q_pos = jnp.arange(t_q)[:, None]
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0))
+    qstat = pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j, kb: (i, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, qspec, qstat],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, t_q, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, LSE_LANES), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, o, lse)[0]
 
-    kb = jnp.swapaxes(k.reshape(bh, nk, block_k, d), 0, 1)
-    vb = jnp.swapaxes(v.reshape(bh, nk, block_k, d), 0, 1)
-
-    def body(dq_acc, blk):
-        kk, vv, idx = blk
-        kkf = kk.astype(jnp.float32)
-        vvf = vv.astype(jnp.float32)
-        s = jnp.einsum("bqd,bkd->bqk", qf, kkf,
-                       preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            k_pos = idx * block_k + jnp.arange(block_k)[None, :]
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, :, None])  # [bh, tq, bk]
-        dv = jnp.einsum("bqk,bqd->bkd", p, dof,
-                        preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, vvf,
-                        preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, :, None]) * sm_scale
-        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kkf,
-                                     preferred_element_type=jnp.float32)
-        dk = jnp.einsum("bqk,bqd->bkd", ds, qf,
-                        preferred_element_type=jnp.float32)
-        return dq_acc, (dk, dv)
-
-    dq0 = jnp.zeros((bh, t_q, d), jnp.float32)
-    dq, (dks, dvs) = jax.lax.scan(body, dq0, (kb, vb, jnp.arange(nk)))
-    dk = jnp.swapaxes(dks, 0, 1).reshape(bh, t_k, d)
-    dv = jnp.swapaxes(dvs, 0, 1).reshape(bh, t_k, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda i, kb, jq: (i, kb, 0))
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda i, kb, jq: (i, jq, 0))
+    qstat2 = pl.BlockSpec((1, block_q, LSE_LANES),
+                          lambda i, kb, jq: (i, jq, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[kspec2, kspec2, qspec2, qspec2, qspec2, qstat2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t_k, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(k, v, q, do, o, lse)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -174,14 +337,15 @@ def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_k)
+    return _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q,
+                      block_k, interpret)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=256,
-                    block_k=256, interpret=None):
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=1024,
+                    block_k=1024, interpret=None):
     """Fused attention.  q [b, t_q, h, d], k/v [b, t_k, h, d] ->
     [b, t_q, h, d].  Differentiable (custom VJP).  ``interpret=None``
     auto-selects Pallas interpreter mode off-TPU so the same code path runs

@@ -96,3 +96,30 @@ def test_flash_attention_jit_under_program():
         np.asarray(attention_reference(q, k, v)),
         atol=2e-5, rtol=2e-5,
     )
+
+
+def test_flash_cross_attention_causal_tq_gt_tk():
+    """Regression: causal cross-attention with t_q > t_k — q blocks whose
+    diagonal lies beyond the last k block must still finalize (the 3-D
+    grid kernel's last_kb needs clamping to nk-1)."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 16, 2, 8) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.randn(2, 8, 2, 8) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.randn(2, 8, 2, 8), jnp.float32)
+    o = flash_attention(q, k, v, causal=True, block_q=8, block_k=8,
+                        interpret=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    ga = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=8, block_k=8, interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: attention_reference(
+        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(ga, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-5)
