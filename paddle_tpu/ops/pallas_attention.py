@@ -156,13 +156,21 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return o, lse
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
-                   dq_scr, delta_scr, *, sm_scale, causal, block_q,
-                   block_k, nk):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, nk,
+                   has_dlse):
     """dq: grid (bh, q-blocks, k-blocks), k innermost; accumulate in VMEM.
-    delta = rowsum(do*o) is computed here (kb==0) instead of being passed
-    as a lane-replicated HBM array."""
+    delta = rowsum(do*o) is computed here (kb==0); an lse cotangent (from
+    callers that consume lse, e.g. ring-attention merges) folds in as
+    ds = p * (dp - delta + dlse) * scale."""
     import jax.experimental.pallas as pl
+
+    if has_dlse:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dlse_ref,
+         dq_ref, dq_scr, delta_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+         dq_ref, dq_scr, delta_scr) = refs
+        dlse_ref = None
 
     j = pl.program_id(1)
     kb = pl.program_id(2)
@@ -173,6 +181,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         d_row = jnp.sum(
             do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
             axis=-1, keepdims=True)
+        if dlse_ref is not None:
+            d_row = d_row - dlse_ref[0][:, :1]
         delta_scr[...] = jnp.broadcast_to(d_row, delta_scr.shape)
 
     if causal:
@@ -218,11 +228,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
-                    block_q, block_k, nq):
+def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, nq,
+                    has_dlse):
     """dk/dv: grid (bh, k-blocks, q-blocks), q innermost."""
     import jax.experimental.pallas as pl
+
+    if has_dlse:
+        (k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref, dlse_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        dlse_ref = None
 
     kb = pl.program_id(1)
     jq = pl.program_id(2)
@@ -240,6 +257,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
         lse = lse_ref[0]
         delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
                         keepdims=True)
+        if dlse_ref is not None:
+            delta = delta - dlse_ref[0][:, :1]
         bq = q.shape[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -276,9 +295,11 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
-               interpret):
+               interpret, dlse=None):
     """Pallas backward: dq kernel (q-major) + dk/dv kernel (k-major),
-    both with causal block skip; O(block^2) VMEM, O(t) HBM residuals."""
+    both with causal block skip; O(block^2) VMEM.  ``dlse`` (lane-
+    replicated [bh, t_q, LSE_LANES], optional) is the cotangent of the
+    returned lse for callers that consume it (ring-attention merges)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -288,39 +309,51 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
     block_k = _pick_block(t_k, block_k)
     nq = t_q // block_q
     nk = t_k // block_k
+    has_dlse = dlse is not None
 
     qspec = pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0))
     qstat = pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j, kb: (i, j, 0))
+    dq_in_specs = [qspec, kspec, kspec, qspec, qspec, qstat]
+    dq_args = [q, k, v, do, o, lse]
+    if has_dlse:
+        dq_in_specs.append(qstat)
+        dq_args.append(dlse)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk),
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          has_dlse=has_dlse),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, qspec, qstat],
+        in_specs=dq_in_specs,
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct((bh, t_q, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
                         pltpu.VMEM((block_q, LSE_LANES), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, o, lse)[0]
+    )(*dq_args)[0]
 
     kspec2 = pl.BlockSpec((1, block_k, d), lambda i, kb, jq: (i, kb, 0))
     qspec2 = pl.BlockSpec((1, block_q, d), lambda i, kb, jq: (i, jq, 0))
     qstat2 = pl.BlockSpec((1, block_q, LSE_LANES),
                           lambda i, kb, jq: (i, jq, 0))
+    dkv_in_specs = [kspec2, kspec2, qspec2, qspec2, qspec2, qstat2]
+    dkv_args = [k, v, q, do, o, lse]
+    if has_dlse:
+        dkv_in_specs.append(qstat2)
+        dkv_args.append(dlse)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q, block_k=block_k,
-                          nq=nq),
+                          nq=nq, has_dlse=has_dlse),
         grid=(bh, nk, nq),
-        in_specs=[kspec2, kspec2, qspec2, qspec2, qspec2, qstat2],
+        in_specs=dkv_in_specs,
         out_specs=[kspec2, kspec2],
         out_shape=[jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, t_k, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(k, v, q, do, o, lse)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -365,6 +398,58 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=1024,
         bool(interpret),
     )
     return jnp.swapaxes(o.reshape(b, h, t_q, d), 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core_lse(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret)
+    return o, lse[:, :, 0]
+
+
+def _flash_core_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret):
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret)
+    return (o, lse[:, :, 0]), (q, k, v, o, lse)
+
+
+def _flash_core_lse_bwd(sm_scale, causal, block_q, block_k, interpret,
+                        res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    bh, t_q, _ = q.shape
+    dlse_rep = jnp.broadcast_to(
+        dlse.astype(jnp.float32)[:, :, None], (bh, t_q, LSE_LANES))
+    return _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q,
+                      block_k, interpret, dlse=dlse_rep)
+
+
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
+                             block_q=1024, block_k=1024, interpret=None):
+    """flash_attention that ALSO returns the per-row logsumexp
+    (o [b, t, h, d], lse [b, h, t]) — the building block for composing
+    partial attentions with online-softmax merges (ring attention).
+    Fully differentiable including through lse."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+
+    def pack(x, t):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, t, x.shape[-1])
+
+    o, lse = _flash_core_lse(
+        pack(q, t_q), pack(k, t_k), pack(v, t_k),
+        float(sm_scale), bool(causal), int(block_q), int(block_k),
+        bool(interpret),
+    )
+    return (jnp.swapaxes(o.reshape(b, h, t_q, d), 1, 2),
+            lse.reshape(b, h, t_q))
 
 
 def attention_reference(q, k, v, causal=False, sm_scale=None):

@@ -85,14 +85,26 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
     return _finalize(o, m, l)
 
 
-def ring_attention(q, k, v, mesh, axis_name="sp", causal=False):
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                   impl="dense", block_q=1024, block_k=1024):
     """Ring attention over a sequence-sharded batch.
 
     q/k/v: [b, t, h, d] GLOBALLY, sharded on t over ``axis_name``.  Must be
     called under the mesh (the function shard_maps itself).  Returns output
     sharded the same way.
+
+    impl="flash" runs each device's inner block through the Pallas flash
+    kernel (ops/pallas_attention.flash_attention_with_lse) and merges the
+    per-step partials by their logsumexp — recommended on TPU for long
+    local blocks; "dense" (default) is the XLA-composed inner block.
     """
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"unknown ring_attention impl {impl!r}; "
+                         f"choose 'dense' or 'flash'")
     sp = mesh.shape[axis_name]
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, mesh, axis_name, causal,
+                                     block_q, block_k)
 
     def local_fn(q_blk, k_blk, v_blk):
         # q_blk etc: [b, t/sp, h, d] local shards
@@ -122,6 +134,79 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False):
             step, (o0, m0, l0, k_blk, v_blk), jnp.arange(sp)
         )
         return _finalize(o, m, l)
+
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def _ring_attention_flash(q, k, v, mesh, axis_name, causal, block_q,
+                          block_k):
+    """Flash-kernel inner blocks composed across the ring: each step the
+    device attends its Q shard to the K/V shard it currently holds via the
+    Pallas kernel (diagonal steps causal, past steps full, future steps
+    skipped), and partial outputs merge by logsumexp — mathematically the
+    same online softmax the dense path carries as (m, l)."""
+    from ..ops.pallas_attention import flash_attention_with_lse
+
+    sp = mesh.shape[axis_name]
+    NEG = -1e30
+
+    def local_fn(q_blk, k_blk, v_blk):
+        b, tl, h, d = q_blk.shape
+        my_idx = jax.lax.axis_index(axis_name)
+
+        # every cond branch returns (o f32, lse f32) so avals match for
+        # bf16 inputs too
+        def fwd_full(kk, vv):
+            o, lse = flash_attention_with_lse(
+                q_blk, kk, vv, causal=False, block_q=block_q,
+                block_k=block_k)
+            return o.astype(jnp.float32), lse.astype(jnp.float32)
+
+        def fwd_diag(kk, vv):
+            o, lse = flash_attention_with_lse(
+                q_blk, kk, vv, causal=True, block_q=block_q,
+                block_k=block_k)
+            return o.astype(jnp.float32), lse.astype(jnp.float32)
+
+        def skip(kk, vv):
+            return (jnp.zeros(q_blk.shape, jnp.float32),
+                    jnp.full((b, h, tl), NEG, jnp.float32))
+
+        def step(carry, i):
+            o, lse_acc, kk, vv = carry
+            src_idx = (my_idx - i) % sp
+            if causal:
+                o2, lse2 = jax.lax.cond(
+                    src_idx == my_idx,
+                    lambda: fwd_diag(kk, vv),
+                    lambda: jax.lax.cond(
+                        src_idx > my_idx,
+                        lambda: skip(kk, vv),
+                        lambda: fwd_full(kk, vv),
+                    ),
+                )
+            else:
+                o2, lse2 = fwd_full(kk, vv)
+            new_lse = jnp.logaddexp(lse_acc, lse2)
+            w1 = jnp.exp(lse_acc - new_lse)
+            w2 = jnp.exp(lse2 - new_lse)
+            cast = lambda x: jnp.swapaxes(x, 1, 2)[..., None]
+            o = o * cast(w1) + o2 * cast(w2)
+            perm = [(j, (j + 1) % sp) for j in range(sp)]
+            kk = jax.lax.ppermute(kk, axis_name, perm)
+            vv = jax.lax.ppermute(vv, axis_name, perm)
+            return (o, new_lse, kk, vv), None
+
+        o0 = jnp.zeros(q_blk.shape, jnp.float32)
+        lse0 = jnp.full((b, h, tl), NEG, jnp.float32)
+        (o, _, _, _), _ = jax.lax.scan(
+            step, (o0, lse0, k_blk, v_blk), jnp.arange(sp))
+        return o.astype(q_blk.dtype)
 
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(

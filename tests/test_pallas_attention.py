@@ -123,3 +123,47 @@ def test_flash_cross_attention_causal_tq_gt_tk():
     for a, r in zip(ga, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_with_lse_matches_dense_including_lse_grads():
+    """o, lse, and gradients THROUGH lse (the ring-merge path) vs dense."""
+    rng = np.random.RandomState(0)
+    b, t, h, d = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(b, t, h, d) * 0.5, jnp.float32)
+               for _ in range(3))
+    from paddle_tpu.ops.pallas_attention import flash_attention_with_lse
+
+    def dense_with_lse(q, k, v, causal):
+        scale = d ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        p = jnp.exp(s - lse[..., None])
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v), lse
+
+    for causal in (False, True):
+        o1, l1 = flash_attention_with_lse(q, k, v, causal=causal,
+                                          block_q=16, block_k=16,
+                                          interpret=True)
+        o2, l2 = dense_with_lse(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-5, atol=1e-5)
+
+        def loss(fn):
+            def f(q, k, v):
+                o, lse = fn(q, k, v)
+                return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+            return f
+
+        ga = jax.grad(loss(lambda q, k, v: flash_attention_with_lse(
+            q, k, v, causal=causal, block_q=16, block_k=16,
+            interpret=True)), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss(lambda q, k, v: dense_with_lse(q, k, v, causal)),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, r in zip(ga, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-4, atol=1e-5)
