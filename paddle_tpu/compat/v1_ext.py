@@ -524,7 +524,7 @@ def maxid_layer(input, name=None, **_):
 
 def sampling_id_layer(input, name=None, **_):
     helper = LayerHelper("sampling_id", name=name)
-    out = helper.create_tmp_variable("int64", list(input.shape[:-1]),
+    out = helper.create_tmp_variable("int32", list(input.shape[:-1]),
                                      stop_gradient=True)
     helper.append_op(type="sampling_id", inputs={"X": [input.name]},
                      outputs={"Out": [out.name]})

@@ -249,9 +249,12 @@ def multibox_loss(Loc, Conf, PriorBox, GtBox, GtLabel,
     bidx = jnp.arange(b)[:, None]
     gidx = jnp.broadcast_to(jnp.arange(g)[None, :], (b, g))
     best_prior = jnp.argmax(iou, axis=1)                      # [b, G]
-    force = jnp.zeros((b, p), jnp.bool_).at[bidx, best_prior].set(valid_gt)
-    forced_gt = jnp.zeros((b, p), best_gt.dtype).at[
-        bidx, best_prior].set(jnp.where(valid_gt, gidx, 0))
+    # padded gts (iou forced to -1) all argmax to prior 0 — route their
+    # scatter writes to an out-of-bounds index so JAX drops them instead
+    # of clobbering a real gt whose best prior is 0
+    tgt_prior = jnp.where(valid_gt, best_prior, p)
+    force = jnp.zeros((b, p), jnp.bool_).at[bidx, tgt_prior].set(True)
+    forced_gt = jnp.zeros((b, p), best_gt.dtype).at[bidx, tgt_prior].set(gidx)
     best_gt = jnp.where(force, forced_gt, best_gt)
     matched = jnp.logical_or(matched, force)
     n_pos = jnp.sum(matched, axis=1)                          # [b]

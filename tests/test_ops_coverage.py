@@ -360,3 +360,31 @@ def test_multibox_loss_matching_and_mining():
     # zero loc offsets on an exactly-matching prior: loc loss ~ 0, so the
     # good case is nearly pure (tiny) conf loss
     assert np.isfinite(good).all() and np.isfinite(bad).all()
+
+
+def test_multibox_loss_bipartite_not_clobbered_by_padding():
+    # A valid gt whose best-overlap prior is index 0 with IoU below the
+    # threshold (0.33): only the bipartite stage can match it. Padded gts
+    # also argmax to prior 0 — their scatter writes must be dropped, not
+    # clobber the forced match.
+    prior = np.array([[0.1, 0.1, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9],
+                      [0.4, 0.1, 0.5, 0.2]], np.float32)
+    gt = np.array([[[0.2, 0.1, 0.4, 0.3], [0, 0, 0, 0]]], np.float32)
+    gl = np.array([[1, -1]], np.int32)  # one real box, one padding
+    loc = np.zeros((1, 3, 4), np.float32)
+    conf_good = np.zeros((1, 3, 3), np.float32)
+    conf_good[0, 0, 1] = 8.0   # forced-matched prior confident in class 1
+    conf_good[0, 1, 0] = 8.0
+    conf_good[0, 2, 0] = 8.0
+    conf_bad = conf_good.copy()
+    conf_bad[0, 0] = [8.0, 0.0, 0.0]  # forced prior says background
+    good = run_op("multibox_loss",
+                  {"Loc": loc, "Conf": conf_good, "PriorBox": prior,
+                   "GtBox": gt, "GtLabel": gl})["Loss"]
+    bad = run_op("multibox_loss",
+                 {"Loc": loc, "Conf": conf_bad, "PriorBox": prior,
+                  "GtBox": gt, "GtLabel": gl})["Loss"]
+    # if the forced match were clobbered, prior 0 would count as a negative
+    # and the "bad" conf (background there) would score LOW
+    assert float(bad[0, 0]) > float(good[0, 0])
+    assert float(bad[0, 0]) > 1.0
