@@ -1,12 +1,15 @@
-"""Benchmark: ResNet-50 training throughput on the available accelerator.
+"""Benchmark: the two flagship training configs on the available accelerator.
 
-Flagship = BASELINE config 2 (reference model config
-``benchmark/paddle/image/resnet.py``; reference CPU number: 81.69 img/s
-train bs64 on 2x Xeon 6148, ``benchmark/IntelOptimizedPaddle.md:39-45``).
-The north-star target is 3000 img/s on a v5e-16 slice => 187.5 img/s/chip;
-``vs_baseline`` reports measured img/s/chip against that per-chip target.
+1. ResNet-50 (BASELINE config 2; reference model config
+   ``benchmark/paddle/image/resnet.py``, reference CPU number 81.69 img/s
+   train bs64, ``benchmark/IntelOptimizedPaddle.md:39-45``).  North star:
+   3000 img/s on v5e-16 => 187.5 img/s/chip.
+2. GPT decoder LM (12L, d=768, 6 heads x d_head=128, t=4096, bf16, flash
+   attention) — the long-context flagship the reference has no analog of;
+   reported as tokens/sec/chip and MFU against the chip's bf16 peak.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} for the
+ResNet flagship, with the GPT numbers under "extra".
 """
 
 import json
@@ -16,12 +19,28 @@ import time
 
 import numpy as np
 
+# bf16 peak TFLOP/s by device_kind substring (public chip specs)
+PEAK_BF16 = (
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v6", 918e12), ("v4", 275e12), ("v3", 123e12),
+)
+
+
+def chip_peak_flops(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    return float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+
 
 def timed_steps(exe, prog, feed, fetch, steps, warmup):
     """Warm up, then time `steps` training steps with async dispatch:
     fetches stay on device so steps pipeline (a per-step host sync would
     add the full host<->device latency to every batch); block once at the
-    end for honest timing. Returns (seconds, last fetches as numpy)."""
+    end for honest timing.  The end-of-region np.asarray forces a real
+    host materialization — through the axon tunnel block_until_ready()
+    alone does not reliably wait.  Returns (seconds, last fetches)."""
     for _ in range(warmup):
         exe.run(prog, feed=feed, fetch_list=fetch)
     t0 = time.perf_counter()
@@ -32,65 +51,147 @@ def timed_steps(exe, prog, feed, fetch, steps, warmup):
     return time.perf_counter() - t0, cost
 
 
-def main():
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-
+def shard_batch(arrays, mesh):
     import jax
+
+    if mesh is None:
+        return [jax.device_put(a) for a in arrays]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("dp"))
+    return [jax.device_put(a, sh) for a in arrays]
+
+
+def bench_resnet(n_chips, mesh_factory, steps, warmup):
+    import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.models import resnet
 
-    n_chips = max(len(jax.devices()), 1)
-
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         outs = resnet.build(depth=50, class_dim=1000,
                             image_shape=(3, 224, 224), dtype="bfloat16")
+    mesh = mesh_factory(main_prog, startup)
+    if mesh is not None:
+        batch *= n_chips
+    exe = pt.Executor(mesh=mesh)
+    exe.run(startup)
 
-    mesh = None
-    if n_chips > 1:
+    # Device-resident synthetic batch: benchmarks the training step, not
+    # the host->device pipe (the input-pipeline proof lives in
+    # benchmarks/input_pipeline.py).
+    img = jnp.asarray(np.random.rand(batch, 3, 224, 224), jnp.bfloat16)
+    label = jnp.asarray(np.random.randint(0, 1000, (batch, 1)), jnp.int32)
+    img, label = shard_batch([img, label], mesh)
+    dt, cost = timed_steps(exe, main_prog, {"img": img, "label": label},
+                           [outs["avg_cost"]], steps, warmup)
+    assert np.isfinite(cost[0]).all()
+    return batch * steps / dt / n_chips
+
+
+def bench_gpt(n_chips, mesh_factory, steps, warmup):
+    """GPT LM training: tokens/sec/chip + MFU.  Model flops follow the
+    PaLM convention: 6*N*tokens over the matmul params plus causal
+    attention 6*L*B*T^2*d fwd+bwd (backward recompute not counted)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    n_layer = int(os.environ.get("BENCH_GPT_LAYERS", "12"))
+    d_model = int(os.environ.get("BENCH_GPT_DMODEL", "768"))
+    n_head = int(os.environ.get("BENCH_GPT_HEADS", "6"))  # d_head = 128
+    seq = int(os.environ.get("BENCH_GPT_SEQ", "4096"))
+    vocab = int(os.environ.get("BENCH_GPT_VOCAB", "32768"))
+    batch = int(os.environ.get("BENCH_GPT_BATCH", "8"))
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        outs = transformer.build(
+            vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+            d_model=d_model, max_len=seq, dropout_rate=0.0,
+            dtype="bfloat16")
+        if os.environ.get("BENCH_GPT_REMAT", "0").lower() not in (
+                "0", "", "false"):
+            # remat costs ~23% at this size and the activations fit on a
+            # 16 GB chip without it; the knob exists for bigger configs
+            pt.memory_optimize(main_prog)
+    mesh = mesh_factory(main_prog, startup)
+    if mesh is not None:
+        batch *= n_chips
+    exe = pt.Executor(mesh=mesh)
+    exe.run(startup)
+
+    toks = jnp.asarray(np.random.randint(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(np.random.randint(0, vocab, (batch, seq)),
+                         jnp.int32)
+    toks, labels = shard_batch([toks, labels], mesh)
+    dt, cost = timed_steps(exe, main_prog,
+                           {"tokens": toks, "labels": labels},
+                           [outs["avg_cost"]], steps, warmup)
+    assert np.isfinite(cost[0]).all()
+
+    tokens_per_s = batch * seq * steps / dt
+    d_ff = 4 * d_model
+    n_mm = (n_layer * (4 * d_model * d_model + 2 * d_model * d_ff)
+            + d_model * vocab)  # matmul params; embedding gathers excluded
+    step_flops = (6 * n_mm * batch * seq
+                  + 6 * n_layer * batch * seq * seq * d_model)
+    peak = chip_peak_flops(jax.devices()[0]) * n_chips
+    mfu = step_flops * steps / dt / peak
+    return tokens_per_s / n_chips, mfu
+
+
+def main():
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    which = os.environ.get("BENCH_MODELS", "resnet,gpt").split(",")
+    unknown = set(which) - {"resnet", "gpt"}
+    if unknown:
+        raise SystemExit(
+            f"BENCH_MODELS contains unknown model(s) {sorted(unknown)}; "
+            f"valid: resnet, gpt")
+
+    import jax
+
+    n_chips = max(len(jax.devices()), 1)
+
+    def mesh_factory(main_prog, startup):
+        if n_chips <= 1:
+            return None
         from paddle_tpu.parallel.mesh import make_mesh
         from paddle_tpu.parallel import api as papi
 
         mesh = make_mesh({"dp": n_chips})
         papi.data_parallel(main_prog, "dp", programs=(startup,))
-        batch *= n_chips
+        return mesh
 
-    exe = pt.Executor(mesh=mesh)
-    exe.run(startup)
+    extra = {}
+    img_per_chip = None
+    if "resnet" in which:
+        img_per_chip = bench_resnet(n_chips, mesh_factory, steps, warmup)
+    if "gpt" in which:
+        tok_per_chip, mfu = bench_gpt(n_chips, mesh_factory, steps, warmup)
+        extra["gpt_tokens_per_sec_per_chip"] = round(tok_per_chip, 1)
+        extra["gpt_mfu"] = round(mfu, 4)
 
-    import jax.numpy as jnp
-
-    # Device-resident synthetic batch: benchmarks the training step, not the
-    # host->device pipe (real input pipelines prefetch to device).
-    img = np.random.rand(batch, 3, 224, 224)
-    label = np.random.randint(0, 1000, (batch, 1))
-    if mesh is None:
-        img = jax.device_put(jnp.asarray(img, dtype=jnp.bfloat16))
-        label = jax.device_put(jnp.asarray(label, dtype=jnp.int32))
-    else:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        batch_sh = NamedSharding(mesh, P("dp"))
-        img = jax.device_put(jnp.asarray(img, dtype=jnp.bfloat16), batch_sh)
-        label = jax.device_put(
-            jnp.asarray(label, dtype=jnp.int32), batch_sh)
-    feed = {"img": img, "label": label}
-    fetch = [outs["avg_cost"]]
-
-    dt, cost = timed_steps(exe, main_prog, feed, fetch, steps, warmup)
-
-    img_per_s = batch * steps / dt
-    per_chip = img_per_s / n_chips
+    if img_per_chip is None:  # gpt-only run (BENCH_MODELS=gpt)
+        print(json.dumps({
+            "metric": "gpt_train_tokens_per_sec_per_chip",
+            "value": extra["gpt_tokens_per_sec_per_chip"],
+            "unit": "tok/s/chip",
+            "vs_baseline": extra["gpt_mfu"],
+        }))
+        return
     target_per_chip = 3000.0 / 16.0
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
+        "value": round(img_per_chip, 2),
         "unit": "img/s/chip",
-        "vs_baseline": round(per_chip / target_per_chip, 3),
+        "vs_baseline": round(img_per_chip / target_per_chip, 3),
+        "extra": extra,
     }))
-    assert np.isfinite(cost[0]).all()
 
 
 if __name__ == "__main__":

@@ -12,11 +12,17 @@ whose per-device inner block this kernel accelerates).
 
 Forward: Pallas kernel, grid (batch*head, q-blocks, k-blocks) with the
 k axis innermost; online-softmax state carried in VMEM scratch; causal
-k-blocks above the diagonal are skipped.  Backward: custom_vjp into two
-Pallas kernels — dq (q-major grid) and dk/dv (k-major grid) — recomputing
-p from the saved lane-replicated lse, also with causal block skip.
+k-blocks above the diagonal are skipped, and the mask select runs only on
+blocks straddling the diagonal.  Backward: custom_vjp into two Pallas
+kernels — dq (q-major grid) and dk/dv (k-major grid) — recomputing p from
+the saved lane-replicated lse, also with causal block skip.
 delta = rowsum(do*o) is computed inside the kernels.  HBM residuals are
-O(t) rows (lse carries 128 f32 lanes/row); VMEM stays O(block^2).
+O(t) rows (lse carries 128 f32 lanes/row, the same layout the public TPU
+flash/splash kernels use); VMEM stays O(block^2).
+
+MXU feeds stay in the input dtype: bf16 q/k/v/do go straight into the
+dots with f32 accumulation (bf16 input is 2x the f32 MXU rate on v5e);
+only softmax state (m/l/lse/p pre-cast) is f32.
 """
 
 import functools
@@ -68,16 +74,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         last_kb = nk - 1
         needed = None
 
-    def _block():
-        q = q_ref[0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
+    def _block(masked):
+        # MXU feeds stay in the INPUT dtype (bf16 in = 2x the f32 MXU
+        # rate); only the softmax state is f32.  Same convention as the
+        # public TPU flash kernels.
+        q = q_ref[0]          # [bq, d]
+        k = k_ref[0]          # [bk, d]
+        v = v_ref[0]
         bq = q.shape[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale  # [bq, bk]
-        if causal:
+        ) * sm_scale  # [bq, bk] f32
+        if masked:
             q_pos = j * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -92,7 +101,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         p = jnp.exp(s - m2[:, :1])
         l2 = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc2 = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[...] = m2
@@ -100,9 +109,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         acc_scr[...] = acc2
 
     if needed is None:
-        _block()
+        _block(False)
     else:
-        pl.when(needed)(_block)
+        # the mask only bites on blocks straddling the diagonal; blocks
+        # fully below it skip the iota/compare/select VPU passes
+        unmasked = j * block_q >= (kb + 1) * block_k - 1
+        pl.when(jnp.logical_and(needed, unmasked))(lambda: _block(False))
+        pl.when(jnp.logical_and(needed, jnp.logical_not(unmasked)))(
+            lambda: _block(True))
 
     @pl.when(kb == last_kb)
     def _finalize():
@@ -192,18 +206,18 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, nk,
     else:
         last_kb = nk - 1
 
-    def _block():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+    def _block(masked):
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]      # [bq, LSE_LANES] lane-replicated
         delta = delta_scr[...]
         bq = q.shape[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if masked:
             q_pos = j * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -213,15 +227,19 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, nk,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, :1]) * sm_scale
+        ds = (p * (dp - delta[:, :1]) * sm_scale).astype(k.dtype)
         dq_scr[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(kb <= last_kb)(_block)
+        unmasked = j * block_q >= (kb + 1) * block_k - 1
+        on = kb <= last_kb
+        pl.when(jnp.logical_and(on, unmasked))(lambda: _block(False))
+        pl.when(jnp.logical_and(on, jnp.logical_not(unmasked)))(
+            lambda: _block(True))
     else:
-        _block()
+        _block(False)
 
     @pl.when(kb == last_kb)
     def _finalize():
@@ -249,21 +267,22 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, nq,
         dk_scr[...] = jnp.zeros_like(dk_scr[...])
         dv_scr[...] = jnp.zeros_like(dv_scr[...])
 
-    def _block():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+    def _block(masked):
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
-        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
-                        keepdims=True)
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True)
         if dlse_ref is not None:
             delta = delta - dlse_ref[0][:, :1]
         bq = q.shape[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if masked:
             q_pos = jq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -271,12 +290,12 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, nq,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, :1])
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, :1]) * sm_scale
+        ds = (p * (dp - delta[:, :1]) * sm_scale).astype(q.dtype)
         dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -284,9 +303,13 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, nq,
     if causal:
         # q block jq touches k block kb iff its last row is at/below the
         # block diagonal: (jq+1)*bq - 1 >= kb*bk
-        pl.when(jq >= (kb * block_k) // block_q)(_block)
+        on = jq >= (kb * block_k) // block_q
+        unmasked = jq * block_q >= (kb + 1) * block_k - 1
+        pl.when(jnp.logical_and(on, unmasked))(lambda: _block(False))
+        pl.when(jnp.logical_and(on, jnp.logical_not(unmasked)))(
+            lambda: _block(True))
     else:
-        _block()
+        _block(False)
 
     @pl.when(jq == nq - 1)
     def _finalize():

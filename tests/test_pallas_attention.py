@@ -69,6 +69,38 @@ def test_flash_gradients_match_reference(causal):
         )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_and_d128(causal):
+    """bf16 inputs take the bf16 MXU-feed path; d=128 heads (the MFU
+    config) must be numerically sound fwd+bwd vs an f32 dense reference."""
+    rng = np.random.default_rng(7)
+    b, t, h, d = 1, 64, 2, 128
+    qf, kf, vf = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5,
+                              jnp.float32) for _ in range(3))
+    q, k, v = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = attention_reference(qf, kf, vf, causal=causal)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    for gf, gr, nm in zip(g_flash, g_ref, "qkv"):
+        # bf16 ~ 3 decimal digits; compare against the row scale
+        scale = np.maximum(np.abs(np.asarray(gr)).max(), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32) / scale, np.asarray(gr) / scale,
+            atol=4e-2, err_msg=f"grad wrt {nm}")
+
+
 def test_flash_attention_op_registered():
     from tests.op_test import run_op
 
