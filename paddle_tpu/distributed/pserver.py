@@ -1,14 +1,23 @@
 """Parameter server — the go/pserver + paddle/pserver rebuild.
 
 Reference capabilities reproduced (SURVEY §L8):
-* blockwise/param sharding across N servers, trainer client picks server by
-  name hash (go/pserver/client/client.go) — here: hash(param_name) % N;
+* intra-parameter BLOCK sharding across N servers: each parameter is split
+  into ~even row-range blocks assigned round-robin starting at the name
+  hash (reference ``distribute_transpiler.py:106-145 split_dense_variable``
+  + blockwise scatter/gather ``ParameterClient2.cpp:352``); small params
+  stay whole on their hash server (client.go name-hash selection);
+* concurrent scatter/gather: the client sends/fetches to all servers in
+  parallel, serial per connection (``ParameterClient2.cpp:146
+  sendParallel``);
 * sync mode: barrier across num_trainers gradient sends, then one optimizer
   step server-side (ParameterServer2 addGradient :482 + doOperation :1269,
   ParameterUpdateMode ADD_GRADIENT);
 * async mode: apply immediately per gradient (ASYNC_SGD);
 * sparse updates: SelectedRows-style (rows, values) payloads
-  (PSERVER_UPDATE_MODE_GET_PARAM_SPARSE);
+  (PSERVER_UPDATE_MODE_GET_PARAM_SPARSE) applied through the CONFIGURED
+  optimizer with per-row state (go/pserver/optimizer.go:51 runs the full
+  optimizer family on sparse sends; lazy semantics — only touched rows'
+  moments advance);
 * server-side optimizers: the SAME optimizer op implementations the trainer
   jits (ops/optimizer_ops.py) run here on host JAX arrays — the analog of
   recv_op executing the optimize sub-block with a local Executor
@@ -21,6 +30,7 @@ import os
 import pickle
 import threading
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -32,6 +42,32 @@ from ..core.registry import get_op_impl
 def assign_server(name, num_servers):
     """Deterministic param→server map (client.go name-hash selection)."""
     return zlib.crc32(name.encode()) % num_servers
+
+
+def split_param(name, shape, num_servers, min_block_elems=8192):
+    """Block plan for one parameter: tuple of ``(server, row0, row1)``.
+
+    Splits along axis 0 into up to ``num_servers`` contiguous row ranges
+    (within one row of even), assigned round-robin starting at the name
+    hash so single-block params still spread.  Parameters smaller than
+    2*min_block_elems (or with <2 rows) stay whole — the reference's
+    min_block_size guard (``distribute_transpiler.py:106-145``).  The plan
+    is a pure function of (name, shape, num_servers): every trainer
+    computes the same plan with no coordination."""
+    shape = tuple(int(s) for s in shape)
+    rows = shape[0] if shape else 1
+    elems = int(np.prod(shape)) if shape else 1
+    base = assign_server(name, num_servers)
+    nb = min(num_servers, rows, max(1, elems // min_block_elems))
+    if nb <= 1:
+        # whole-param form (row range None): scalars/0-d params can't be
+        # row-sliced, and single-block params need no reassembly
+        return ((base, None, None),)
+    return tuple(
+        ((base + b) % num_servers,
+         b * rows // nb, (b + 1) * rows // nb)
+        for b in range(nb)
+    )
 
 
 class _OptimizerState:
@@ -59,6 +95,10 @@ class _OptimizerState:
             ("Moment1", "Moment1Out"), ("Moment2", "Moment2Out"),
             ("Beta1Pow", "Beta1PowOut"), ("Beta2Pow", "Beta2PowOut"),
         ],
+        "adamax": [
+            ("Moment", "MomentOut"), ("InfNorm", "InfNormOut"),
+            ("Beta1Pow", "Beta1PowOut"),
+        ],
         "adadelta": [
             ("AvgSquaredGrad", "AvgSquaredGradOut"),
             ("AvgSquaredUpdate", "AvgSquaredUpdateOut"),
@@ -69,9 +109,19 @@ class _OptimizerState:
             ("LinearAccumulator", "LinearAccumOut"),
         ],
         "decayed_adagrad": [("Moment", "MomentOut")],
+        "proximal_gd": [],
+        "proximal_adagrad": [("Moment", "MomentOut")],
     }
 
     def step(self, param, grad):
+        if any(k.endswith("@rows") for k in self.acc):
+            # dense and row-sparse adam/adamax track bias correction in
+            # different state (scalar pow vs per-row pows); mixing them
+            # on one parameter silently mis-scales updates — forbid it
+            raise ValueError(
+                f"parameter already updated through the sparse path "
+                f"({self.op_type}); cannot mix dense step() with "
+                f"step_rows() on one parameter")
         impl = get_op_impl(self.op_type)
         ins = {"Param": param, "Grad": grad, "LearningRate": self.lr}
         slots = self._STATE_SLOTS[self.op_type]
@@ -82,6 +132,95 @@ class _OptimizerState:
             if out_name in outs:
                 self.acc[in_name] = np.asarray(outs[out_name])
         return np.asarray(outs["ParamOut"])
+
+    def _ensure_row_pow(self, name, n_rows):
+        """Per-row beta-power vector [n_rows, 1] (init 1.0) for lazy
+        sparse adam/adamax: each row's bias correction tracks how many
+        times THAT row was touched."""
+        key = name + "@rows"
+        if key not in self.acc:
+            self.acc[key] = np.ones((n_rows, 1), np.float32)
+        return self.acc[key]
+
+    def step_rows(self, param, rows, values):
+        """Row-sparse update with full optimizer semantics, lazy mode:
+        only the touched rows' moments/pows advance (the reference runs
+        the configured optimizer on sparse sends — go/pserver/optimizer.go:51
+        cgo into the C++ optimizer lib; ParameterServer2.cpp:1269
+        doOperation).  Mutates ``param`` in place and returns it.
+
+        Duplicate rows are merge-added first (SelectedRows merge
+        semantics); negative rows (padding ids) are dropped."""
+        param = np.asarray(param, np.float32)
+        if not param.flags.writeable:
+            # e.g. a numpy view of a jax.Array that reached the server
+            # without a pickle roundtrip — the in-place row update needs
+            # an owned buffer
+            param = param.copy()
+        rows = np.asarray(rows)
+        values = np.asarray(values, np.float32)
+        valid = rows >= 0
+        rows, values = rows[valid], values[valid]
+        if rows.size == 0:
+            return param
+        uniq, inv = np.unique(rows, return_inverse=True)
+        if uniq.size != rows.size:
+            merged = np.zeros((uniq.size,) + values.shape[1:], np.float32)
+            np.add.at(merged, inv, values)
+            rows, values = uniq, merged
+        lr = float(self.lr[0])
+        a = self.attrs
+        if self.op_type == "sgd":
+            param[rows] -= lr * values
+            return param
+        if self.op_type in ("adam", "adamax"):
+            # the op impls take SCALAR beta pows; rows touched different
+            # numbers of times need per-row pows, so the row math lives
+            # here — pinned to the dense op impl by
+            # tests/test_distributed.py (sparse-vs-dense equivalence)
+            if "Beta1Pow" in self.acc:
+                raise ValueError(
+                    f"parameter already updated through the dense path "
+                    f"({self.op_type}); cannot mix step_rows() with "
+                    f"dense step() on one parameter")
+            b1 = a.get("beta1", 0.9)
+            b2 = a.get("beta2", 0.999)
+            eps = a.get("epsilon", 1e-8)
+            if self.op_type == "adam":
+                m1 = self._ensure("Moment1", param.shape)
+                m2 = self._ensure("Moment2", param.shape)
+                b1p = self._ensure_row_pow("Beta1Pow", param.shape[0])
+                b2p = self._ensure_row_pow("Beta2Pow", param.shape[0])
+                m1[rows] = b1 * m1[rows] + (1 - b1) * values
+                m2[rows] = b2 * m2[rows] + (1 - b2) * values * values
+                b1p[rows] *= b1
+                b2p[rows] *= b2
+                lr_t = lr * np.sqrt(1 - b2p[rows]) / (1 - b1p[rows])
+                param[rows] -= lr_t * m1[rows] / (np.sqrt(m2[rows]) + eps)
+            else:
+                m = self._ensure("Moment", param.shape)
+                u = self._ensure("InfNorm", param.shape)
+                b1p = self._ensure_row_pow("Beta1Pow", param.shape[0])
+                m[rows] = b1 * m[rows] + (1 - b1) * values
+                u[rows] = np.maximum(b2 * u[rows], np.abs(values))
+                b1p[rows] *= b1
+                param[rows] -= (lr / (1 - b1p[rows])) * m[rows] / (
+                    u[rows] + eps)
+            return param
+        # pow-free optimizers: run the REGISTERED op impl on the row
+        # slice with row-sliced state (same update rule, sliced view)
+        impl = get_op_impl(self.op_type)
+        ins = {"Param": param[rows], "Grad": values,
+               "LearningRate": self.lr}
+        slots = self._STATE_SLOTS[self.op_type]
+        for in_name, _ in slots:
+            ins[in_name] = self._ensure(in_name, param.shape)[rows]
+        outs = impl.call(ins, self.attrs, None)
+        for in_name, out_name in slots:
+            if out_name in outs:
+                self.acc[in_name][rows] = np.asarray(outs[out_name])
+        param[rows] = np.asarray(outs["ParamOut"])
+        return param
 
     def get_states(self):
         return {"acc": self.acc, "op_type": self.op_type, "lr": self.lr}
@@ -104,6 +243,7 @@ class ParameterServer:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every_n_updates
         self.params = {}
+        self.meta = {}
         self.opt = {}
         self._grad_acc = {}
         self._grad_count = {}
@@ -119,9 +259,35 @@ class ParameterServer:
         with self._lock:
             if self._init_done:
                 return False
-            self.params[name] = np.asarray(value)
+            # own the buffer: with in-process servers (no pickle
+            # roundtrip) np.asarray would alias the caller's array and
+            # step_rows' in-place row updates would mutate it
+            self.params[name] = np.array(value)
             self.opt[name] = _OptimizerState(optimizer, lr, attrs)
             return True
+
+    def set_param_meta(self, name, shape, min_block_elems=8192):
+        """Record a logical parameter's GLOBAL shape + the block-size
+        knob its plan was built with (stored on the name-hash server) so
+        every client — late-attaching or differently configured —
+        rebuilds the SAME block plan.  First writer wins (matching
+        init_param): a second trainer with a different knob must not
+        re-route blocks the first already placed.  A server recovered
+        from a pre-block-sharding checkpoint already stores the param
+        WHOLE under its bare name — registering block meta for it would
+        route every later send/fetch to block keys that don't exist, so
+        refuse."""
+        with self._lock:
+            if self._init_done and name in self.params:
+                return False  # param exists whole (recovered legacy data)
+            self.meta.setdefault(name, {
+                "shape": tuple(int(s) for s in shape),
+                "min_block_elems": int(min_block_elems),
+            })
+        return True
+
+    def get_param_meta(self, name):
+        return self.meta.get(name)
 
     def finish_init_params(self):
         with self._lock:
@@ -156,19 +322,23 @@ class ParameterServer:
             return True
 
     def send_sparse_grad(self, name, rows, values):
-        """SelectedRows update (sparse pserver path)."""
-        rows = np.asarray(rows)
-        values = np.asarray(values)
+        """SelectedRows update (sparse pserver path) through the
+        CONFIGURED optimizer with per-row state (lazy semantics)."""
         with self._lock:
-            p = self.params[name]
-            lr = float(self.opt[name].lr[0])
-            valid = rows >= 0
-            p[rows[valid]] -= lr * values[valid]
+            orig_dtype = self.params[name].dtype
+            updated = self.opt[name].step_rows(
+                np.asarray(self.params[name], np.float32),
+                rows, values)
+            # the update math runs f32; the STORED dtype must not drift
+            # from what init_param recorded
+            self.params[name] = updated.astype(orig_dtype, copy=False)
             self._after_update()
         return True
 
     def get_param(self, name):
         with self._lock:
+            # the live buffer: RPC copies via pickle; the in-process
+            # client copies at its call boundary (PServerClient._call)
             return self.params[name]
 
     def get_param_rows(self, name, rows):
@@ -195,6 +365,7 @@ class ParameterServer:
         payload = pickle.dumps(
             {
                 "params": self.params,
+                "meta": self.meta,  # block-plan recovery for reattachers
                 "opt": {k: o.get_states() for k, o in self.opt.items()},
                 "updates": self._updates,
             }
@@ -218,6 +389,7 @@ class ParameterServer:
             raise IOError(f"pserver checkpoint CRC mismatch: {meta['path']}")
         state = pickle.loads(payload)
         self.params = state["params"]
+        self.meta = state.get("meta", {})
         for k, s in state["opt"].items():
             o = _OptimizerState()
             o.set_states(s)
@@ -227,9 +399,24 @@ class ParameterServer:
 
 
 class PServerClient:
-    """Trainer-side client over N shard servers (go/pserver/client)."""
+    """Trainer-side client over N shard servers (go/pserver/client) with
+    intra-parameter block sharding and concurrent multi-server
+    scatter/gather (``ParameterClient2.cpp:146 sendParallel``, ``:352``
+    blockwise send).
 
-    def __init__(self, endpoints_or_servers, store=None):
+    Block plans are a pure function of (name, shape, num_servers)
+    (``split_param``), so every trainer derives the same routing without
+    coordination; shapes are learned at ``init_params`` (every trainer
+    calls it; re-inits after ``finish_init_params`` are no-ops
+    server-side) or lazily from a whole-param fetch.
+
+    Concurrency model: parallel ACROSS servers, sequential per server
+    connection, with every trainer enumerating blocks in the same sorted
+    order — the same discipline that makes the sync ADD_GRADIENT barrier
+    deadlock-free in the reference client."""
+
+    def __init__(self, endpoints_or_servers, store=None,
+                 min_block_elems=8192):
         self._shards = []
         for e in endpoints_or_servers:
             if isinstance(e, ParameterServer):
@@ -237,34 +424,316 @@ class PServerClient:
             else:
                 self._shards.append(rpc.Client(e))
         self.store = store
+        self.min_block_elems = min_block_elems
+        self._plans = {}
+        self._fallback_plans = {}
+        self._shapes = {}
+        self._dtypes = {}
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, len(self._shards)))
+
+    def close(self):
+        """Release worker threads and RPC connections (long-running
+        trainers that rebuild clients on reconnect must not leak)."""
+        self._pool.shutdown(wait=False)
+        for s in self._shards:
+            if isinstance(s, rpc.Client):
+                s.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def _call(self, shard, method, *args):
         target = self._shards[shard]
         if isinstance(target, ParameterServer):
-            return getattr(target, method)(*args)
+            result = getattr(target, method)(*args)
+            if method == "get_param":
+                # isolate in-process callers from the server's live
+                # buffer (step_rows updates rows in place); RPC paths
+                # get this isolation from pickle for free
+                result = np.array(result)
+            return result
         return target.call(method, *args)
 
     def _shard_of(self, name):
         return assign_server(name, len(self._shards))
 
+    # -- block plumbing ----------------------------------------------------
+    def _plan(self, name, shape=None):
+        """Block plan for ``name``.  Without a shape in hand, recover it
+        from the name-hash server's param meta (registered at
+        init_params) — the late-attach path; if the servers predate
+        block sharding (no meta), fall back to a single whole-param
+        block on the hash server."""
+        plan = self._plans.get(name)
+        if plan is not None:
+            return plan
+        if name in self._fallback_plans:
+            # legacy servers hold the param whole and can never grow
+            # meta — honor the cached fallback on every path (a shape in
+            # hand doesn't change what the servers store)
+            return self._fallback_plans[name]
+        # the server-recorded meta (first initializer's shape + knob)
+        # always wins over this client's local config, so differently-
+        # configured clients never derive divergent block layouts
+        meta, legacy = self._meta_lookup(name)
+        if meta is not None:
+            plan = split_param(name, meta["shape"], len(self._shards),
+                               meta["min_block_elems"])
+            self._plans[name] = plan
+            self._shapes[name] = tuple(meta["shape"])
+            return plan
+        if legacy:
+            # pre-block-sharding servers hold params WHOLE under their
+            # bare name (even when a shape is in hand, splitting would
+            # route to block keys no server stores); they can never grow
+            # meta, so the fallback is cached
+            plan = ((self._shard_of(name), None, None),)
+            self._fallback_plans[name] = plan
+            return plan
+        if shape is not None:
+            # modern servers, meta not registered yet (racing the
+            # initializer): provisional local-knob plan, NOT cached —
+            # the next call re-validates against meta
+            return split_param(name, shape, len(self._shards),
+                               self.min_block_elems)
+        # no meta yet and no shape: provisional whole-param, uncached
+        return ((self._shard_of(name), None, None),)
+
+    def _set_meta_safe(self, server, name, shape):
+        """-> True registered / False server refused (recovered legacy
+        data stored whole) / None legacy server without meta support."""
+        try:
+            return self._call(server, "set_param_meta", name, shape,
+                              self.min_block_elems)
+        except AttributeError:
+            return None  # legacy server: no meta support, plans stay local
+        except RuntimeError as e:
+            if "AttributeError" not in str(e):
+                raise
+            return None
+
+    def _meta_lookup(self, name):
+        """-> (meta-or-None, is_legacy_server)."""
+        try:
+            return self._call(self._shard_of(name), "get_param_meta",
+                              name), False
+        except AttributeError:
+            return None, True  # pre-block-sharding in-process server
+        except RuntimeError as e:
+            # rpc wraps remote errors; only a missing method means a
+            # legacy server — transport failures must surface
+            if "AttributeError" not in str(e):
+                raise
+            return None, True
+
+    def _warm_plans(self, names):
+        """Batch the meta probes for uncached names through the parallel
+        fan-out, so neither init_params nor a late-attach client's first
+        send/fetch pays one sequential RTT per parameter."""
+        todo = [n for n in names
+                if n not in self._plans and n not in self._fallback_plans]
+        if not todo:
+            return
+        probes = self._per_server([
+            (self._shard_of(n), n, (lambda n=n: self._meta_lookup(n)))
+            for n in todo
+        ])
+        for n, (meta, legacy) in probes.items():
+            if meta is not None:
+                self._plans[n] = split_param(
+                    n, meta["shape"], len(self._shards),
+                    meta["min_block_elems"])
+                self._shapes[n] = tuple(meta["shape"])
+            elif legacy:
+                self._fallback_plans[n] = (
+                    (self._shard_of(n), None, None),)
+
+    @staticmethod
+    def _block_key(name, plan, bi):
+        return name if len(plan) == 1 else f"{name}#blk{bi}"
+
+    def _per_server(self, items):
+        """items: iterable of (server, fn_args...) -> run each server's
+        list sequentially (in order), servers concurrently.  Returns
+        {key: result} merged from all servers."""
+        by_server = {}
+        for server, key, call in items:
+            by_server.setdefault(server, []).append((key, call))
+
+        def run(server):
+            return [(key, call()) for key, call in by_server[server]]
+
+        out = {}
+        futs = [self._pool.submit(run, s) for s in sorted(by_server)]
+        for f in futs:
+            for key, result in f.result():
+                out[key] = result
+        return out
+
+    # -- public API --------------------------------------------------------
     def init_params(self, named_params, optimizer="sgd", lr=0.01, attrs=None):
-        for name, value in named_params.items():
-            self._call(
-                self._shard_of(name), "init_param", name, np.asarray(value),
-                optimizer, lr, attrs,
-            )
+        names = sorted(named_params)
+        # phase 0: one PARALLEL meta probe over all params (a possibly
+        # earlier initializer's plans must win; serial per-param RPCs
+        # here would add P x RTT to startup)
+        self._warm_plans(names)
+        jobs = []
+        for name in names:
+            value = np.asarray(named_params[name])
+            if (name not in self._plans
+                    and name not in self._fallback_plans):
+                self._plans[name] = split_param(
+                    name, value.shape, len(self._shards),
+                    self.min_block_elems)
+            plan = self._plan(name)
+            self._shapes[name] = tuple(value.shape)
+            self._dtypes[name] = value.dtype
+            # meta rides the parallel fan-out with the blocks
+            jobs.append((self._shard_of(name), f"{name}@meta", (
+                lambda s=self._shard_of(name), n=name,
+                sh=tuple(value.shape): self._set_meta_safe(s, n, sh))))
+            for bi, (server, r0, r1) in enumerate(plan):
+                key = self._block_key(name, plan, bi)
+                blk = value if r0 is None else value[r0:r1]
+                jobs.append((server, key, (
+                    lambda s=server, k=key, b=np.asarray(blk): self._call(
+                        s, "init_param", k, b, optimizer, lr, attrs))))
+        results = self._per_server(jobs)
+        for name in names:
+            if results.get(f"{name}@meta") is False:
+                # the server refused block meta: it holds this param
+                # WHOLE from a pre-block-sharding checkpoint — route
+                # whole, not to block keys that don't exist
+                self._plans.pop(name, None)
+                self._fallback_plans[name] = (
+                    (self._shard_of(name), None, None),)
+        # post-register validation: if another initializer with a
+        # DIFFERENT block-size knob raced us, set_param_meta's
+        # first-writer-wins means the authoritative plan may not be the
+        # one we just cached — fail loudly rather than route blocks to a
+        # divergent layout forever
+        checks = self._per_server([
+            (self._shard_of(n), n, (lambda n=n: self._meta_lookup(n)))
+            for n in names
+        ])
+        for n, (meta, _legacy) in checks.items():
+            if meta is None:
+                continue
+            authoritative = split_param(n, meta["shape"],
+                                        len(self._shards),
+                                        meta["min_block_elems"])
+            if authoritative != self._plans.get(n, authoritative):
+                raise ValueError(
+                    f"concurrent init_params with mismatched "
+                    f"min_block_elems for {n!r}: this client built "
+                    f"{self._plans[n]} but the registered meta implies "
+                    f"{authoritative} — configure every trainer's "
+                    f"PServerClient with the same min_block_elems")
         for i in range(len(self._shards)):
             self._call(i, "finish_init_params")
 
     def send_grads(self, named_grads):
-        for name, g in named_grads.items():
-            self._call(self._shard_of(name), "send_grad", name, np.asarray(g))
+        self._warm_plans(sorted(named_grads))
+        jobs = []
+        for name in sorted(named_grads):
+            g = np.asarray(named_grads[name])
+            plan = self._plan(name, g.shape)
+            for bi, (server, r0, r1) in enumerate(plan):
+                key = self._block_key(name, plan, bi)
+                blk = g if r0 is None else g[r0:r1]
+                jobs.append((server, key, (
+                    lambda s=server, k=key, b=np.asarray(blk): self._call(
+                        s, "send_grad", k, b))))
+        self._per_server(jobs)
+
+    def _route_rows(self, name, rows):
+        """Shared row→block routing for the sparse paths: returns
+        ``(plan, [(server, key, local_rows, mask)])`` with every
+        non-negative row covered by exactly one block, raising IndexError
+        for rows outside the table (negative rows = padding, dropped by
+        design — same contract as the single-server path)."""
+        plan = self._plan(name)
+        routed = []
+        covered = rows < 0
+        for bi, (server, r0, r1) in enumerate(plan):
+            key = self._block_key(name, plan, bi)
+            if r0 is None:
+                routed.append((server, key, rows, None))
+                covered |= True
+            else:
+                m = (rows >= r0) & (rows < r1)
+                covered |= m
+                if m.any():
+                    routed.append((server, key, rows[m] - r0, m))
+        if not np.all(covered):
+            raise IndexError(
+                f"rows {rows[~covered]} outside every block of {name!r} "
+                f"(table rows: 0..{plan[-1][2]})")
+        return plan, routed
 
     def send_sparse_grad(self, name, rows, values):
-        self._call(self._shard_of(name), "send_sparse_grad", name, rows, values)
+        rows = np.asarray(rows)
+        values = np.asarray(values)
+        _, routed = self._route_rows(name, rows)
+        self._per_server([
+            (server, key, (
+                lambda s=server, k=key, r=local_rows,
+                v=(values if mask is None else values[mask]): self._call(
+                    s, "send_sparse_grad", k, r, v)))
+            for server, key, local_rows, mask in routed
+        ])
 
     def get_params(self, names):
-        return {n: self._call(self._shard_of(n), "get_param", n) for n in names}
+        self._warm_plans(sorted(names))
+        jobs = []
+        metas = {}
+        for name in sorted(names):
+            plan = self._plan(name)
+            metas[name] = plan
+            for bi, (server, r0, r1) in enumerate(plan):
+                key = self._block_key(name, plan, bi)
+                jobs.append((server, key, (
+                    lambda s=server, k=key: self._call(s, "get_param", k))))
+        got = self._per_server(jobs)
+        out = {}
+        for name in names:
+            plan = metas[name]
+            blocks = [got[self._block_key(name, plan, bi)]
+                      for bi in range(len(plan))]
+            out[name] = (blocks[0] if len(blocks) == 1
+                         else np.concatenate(blocks, axis=0))
+        return out
 
     def get_param_rows(self, name, rows):
-        return self._call(self._shard_of(name), "get_param_rows", name, rows)
+        """Sparse row fetch (prefetch path): rows routed to their block's
+        server, results reassembled in input order.  Rows outside every
+        block (beyond the table) raise rather than returning garbage."""
+        rows = np.asarray(rows)
+        if rows.size and (rows < 0).any():
+            raise IndexError(
+                f"negative row ids in get_param_rows({name!r}): padding "
+                f"ids are only meaningful for gradient sends")
+        plan = self._plan(name)
+        if len(plan) == 1 and plan[0][1] is None:
+            return self._call(plan[0][0], "get_param_rows", name, rows)
+        if rows.size == 0:
+            shape = self._shapes.get(name)
+            return np.zeros((0,) + tuple(shape[1:] if shape else ()),
+                            self._dtypes.get(name, np.float32))
+        _, routed = self._route_rows(name, rows)
+        got = self._per_server([
+            (server, key, (
+                lambda s=server, k=key, r=local_rows: self._call(
+                    s, "get_param_rows", k, r)))
+            for server, key, local_rows, mask in routed
+        ])
+        first = next(iter(got.values()))
+        out = np.zeros((rows.size,) + np.asarray(first).shape[1:],
+                       np.asarray(first).dtype)
+        for server, key, local_rows, mask in routed:
+            out[mask if mask is not None else slice(None)] = got[key]
+        return out

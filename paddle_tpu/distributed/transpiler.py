@@ -15,6 +15,7 @@ server-side.  ``DistributedTrainer`` is the send/recv loop (the send_op /
 recv_op pair) over the RPC clients."""
 
 import copy
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -88,17 +89,70 @@ class DistributeTranspiler:
 
 class DistributedTrainer:
     """The send/recv loop (send_op.cc:35 / recv_op.cc:86 analog): run the
-    trainer program, push grads, pull fresh params into the Scope."""
+    trainer program, push grads, pull fresh params into the Scope.
+
+    ``sparse_params={param_name: ids_feed_name}`` routes those parameters
+    (embedding tables) through the sparse path: before each step the rows
+    the batch will touch are PREFETCHED from the servers
+    (``GradientMachine::prefetch`` + ``SparseRemoteParameterUpdater``,
+    reference ``RemoteParameterUpdater.h:265``), and after the step only
+    the touched gradient rows are sent (``send_sparse_grad``), applied
+    server-side by the configured optimizer with per-row state."""
 
     def __init__(self, transpiler, executor, pserver_endpoints_or_servers,
-                 learning_rate=0.01):
+                 learning_rate=0.01, sparse_params=None):
         self.t = transpiler
         self.exe = executor
         self.client = PServerClient(pserver_endpoints_or_servers)
         self.trainer_program = transpiler.get_trainer_program()
         self.param_names = sorted(transpiler.optimize_info)
+        self.sparse = dict(sparse_params or {})
+        unknown = set(self.sparse) - set(self.param_names)
+        if unknown:
+            raise ValueError(f"sparse_params not in program: {unknown}")
+        self.dense_names = [p for p in self.param_names
+                            if p not in self.sparse]
         self.lr = learning_rate
-        self._grad_fetch = [p + GRAD_SUFFIX for p in self.param_names]
+        # per-param prefetch/send fan-out pool (distinct from the
+        # client's per-server pool, so nesting cannot deadlock)
+        self._sparse_pool = (
+            ThreadPoolExecutor(max_workers=len(self.sparse))
+            if self.sparse else None)
+        # sparse params fetch only the TOUCHED gradient rows: a gather of
+        # <p>@GRAD by a fed row-id vector appended to the trainer program
+        # (runs post-backward, on device), so host traffic is O(rows) not
+        # O(vocab) — the point of the sparse path (reference
+        # SparseRemoteParameterUpdater, RemoteParameterUpdater.h:265)
+        block = self.trainer_program.global_block()
+        self._grad_fetch = []
+        for p in self.param_names:
+            if p not in self.sparse:
+                self._grad_fetch.append(p + GRAD_SUFFIX)
+                continue
+            pshape = tuple(block.var(p).shape)
+            rows_var = block.create_var(
+                name=f"{p}@ROWIDS", shape=(-1,), dtype="int64",
+                is_data=True, stop_gradient=True)
+            out_var = block.create_var(
+                name=f"{p}@GRADROWS", shape=(-1,) + pshape[1:],
+                dtype="float32", stop_gradient=True)
+            block.append_op(
+                "gather",
+                inputs={"X": [p + GRAD_SUFFIX], "Index": [rows_var.name]},
+                outputs={"Out": [out_var.name]})
+            self._grad_fetch.append(out_var.name)
+
+    def close(self):
+        """Release the client's worker pool and RPC connections."""
+        if self._sparse_pool is not None:
+            self._sparse_pool.shutdown(wait=False)
+        self.client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def init_params_on_pservers(self):
         """Trainer 0 pushes initial values (reference: trainer 0 runs the
@@ -112,15 +166,59 @@ class DistributedTrainer:
         attrs = self.t.optimize_info[first]["attrs"] if first else {}
         self.client.init_params(named, optimizer=opt, lr=self.lr, attrs=attrs)
 
+    def _batch_rows(self, feed, feed_name):
+        ids = np.unique(np.asarray(feed[feed_name]).ravel().astype(np.int64))
+        return ids[ids >= 0]
+
     def train_step(self, feed, extra_fetch=()):
-        """One iteration: local fwd/bwd → send grads → recv params."""
+        """One iteration: prefetch sparse rows → local fwd/bwd → send
+        dense grads + sparse grad rows → recv dense params."""
+        import jax.numpy as jnp
+
         scope = global_scope()
+        feed = dict(feed)
+        padded_ids = {}
+        prefetch = {}
+        for pname, feed_name in self.sparse.items():
+            ids = self._batch_rows(feed, feed_name)
+            # fixed-length padded id vector (pad = -1): keeps the feed
+            # signature stable across batches so the step isn't recompiled
+            # per distinct unique-id count; the gather wraps -1 to the
+            # LAST row (jnp.take), whose value is then dropped
+            # server-side because its row id is negative
+            raw_len = int(np.asarray(feed[feed_name]).size)
+            padded = np.full(raw_len, -1, np.int64)
+            padded[:ids.size] = ids
+            padded_ids[pname] = padded
+            feed[f"{pname}@ROWIDS"] = padded
+            if ids.size == 0:  # all-padding batch for this slot
+                continue
+            # all params' row fetches in flight together (each fans out
+            # across servers inside the client)
+            prefetch[pname] = (ids, self._sparse_pool.submit(
+                self.client.get_param_rows, pname, ids))
+        for pname, (ids, fut) in prefetch.items():
+            fresh_rows = fut.result()
+            # device-side row scatter: no O(table) host round-trip
+            table = jnp.asarray(scope.get(pname))
+            table = table.at[jnp.asarray(ids)].set(
+                jnp.asarray(fresh_rows, table.dtype))
+            scope.set(pname, table)
         block = self.trainer_program.global_block()
         fetch_vars = [block.var(n) for n in self._grad_fetch] + list(extra_fetch)
         vals = self.exe.run(self.trainer_program, feed=feed, fetch_list=fetch_vars)
         grads = dict(zip(self.param_names, vals[: len(self.param_names)]))
-        self.client.send_grads(grads)
-        fresh = self.client.get_params(self.param_names)
+        self.client.send_grads({n: grads[n] for n in self.dense_names})
+        sends = [
+            self._sparse_pool.submit(self.client.send_sparse_grad, pname,
+                                     padded_ids[pname],
+                                     np.asarray(grads[pname]))
+            for pname in self.sparse
+            if (padded_ids[pname] >= 0).sum() > 0
+        ]
+        for f in sends:
+            f.result()
+        fresh = self.client.get_params(self.dense_names)
         for name, value in fresh.items():
             scope.set(name, value)
         return vals[len(self.param_names):]

@@ -533,3 +533,406 @@ def test_multihost_two_process_cpu(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+# ------------------------------------------------- block sharding (round 3)
+def test_split_param_plan_balance():
+    """[1e6, 64] embedding over 4 servers: 4 contiguous row blocks within
+    one row of even (reference split_dense_variable,
+    distribute_transpiler.py:106-145), all servers used, deterministic."""
+    from paddle_tpu.distributed.pserver import split_param
+
+    plan = split_param("emb.w", (1_000_000, 64), 4)
+    assert len(plan) == 4
+    assert {s for s, _, _ in plan} == {0, 1, 2, 3}
+    sizes = [r1 - r0 for _, r0, r1 in plan]
+    assert max(sizes) - min(sizes) <= 1
+    spans = sorted((r0, r1) for _, r0, r1 in plan)
+    assert spans[0][0] == 0 and spans[-1][1] == 1_000_000
+    for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+        assert a1 == b0
+    assert plan == split_param("emb.w", (1_000_000, 64), 4)
+    # small params stay whole (min_block guard)
+    assert len(split_param("fc.b", (10,), 4)) == 1
+    assert len(split_param("w", (3, 3), 4)) == 1
+
+
+def test_block_sharded_init_fetch_train():
+    """A [100, 8] param splits into 4 blocks on 4 servers; fetch
+    reassembles exactly; a dense SGD step applies blockwise."""
+    servers = [ParameterServer(index=i, num_trainers=1) for i in range(4)]
+    client = PServerClient(servers, min_block_elems=64)
+    w = np.arange(100 * 8, dtype=np.float32).reshape(100, 8)
+    client.init_params({"w": w}, optimizer="sgd", lr=0.5)
+    assert [len(s.params) for s in servers] == [1, 1, 1, 1]
+    np.testing.assert_array_equal(client.get_params(["w"])["w"], w)
+    client.send_grads({"w": np.ones_like(w)})
+    np.testing.assert_allclose(client.get_params(["w"])["w"], w - 0.5)
+
+
+def test_block_sharded_training_matches_single_server():
+    """Same gradient stream through a 1-server client and a 4-server
+    block-sharded client (momentum): bit-equal trajectories."""
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=(64, 4)).astype(np.float32)
+    single = PServerClient([ParameterServer(index=0, num_trainers=1)])
+    sharded = PServerClient(
+        [ParameterServer(index=i, num_trainers=1) for i in range(4)],
+        min_block_elems=32)
+    for c in (single, sharded):
+        c.init_params({"w": w0.copy()}, optimizer="momentum", lr=0.1,
+                      attrs={"mu": 0.9})
+    for step in range(5):
+        g = rng.normal(size=w0.shape).astype(np.float32)
+        single.send_grads({"w": g})
+        sharded.send_grads({"w": g})
+    np.testing.assert_array_equal(single.get_params(["w"])["w"],
+                                  sharded.get_params(["w"])["w"])
+
+
+def test_parallel_scatter_overlaps_servers():
+    """The client's scatter/gather overlaps across servers (the
+    sendParallel analog, ParameterClient2.cpp:146): measured by a
+    max-in-flight counter across 4 slow servers, not wall-clock (which
+    flakes under CI load)."""
+    in_flight = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    class SlowServer(ParameterServer):
+        def send_grad(self, name, grad):
+            with lock:
+                in_flight[0] += 1
+                peak[0] = max(peak[0], in_flight[0])
+            time.sleep(0.03)
+            try:
+                return super().send_grad(name, grad)
+            finally:
+                with lock:
+                    in_flight[0] -= 1
+
+    servers = [SlowServer(index=i, num_trainers=1) for i in range(4)]
+    client = PServerClient(servers, min_block_elems=32)
+    w = np.zeros((64, 4), np.float32)
+    client.init_params({"w": w}, optimizer="sgd", lr=0.1)
+    client.send_grads({"w": np.ones_like(w)})
+    assert peak[0] >= 2, f"sends never overlapped (peak={peak[0]})"
+
+
+def test_sparse_rows_adam_matches_dense_when_all_rows_touched():
+    """Lazy sparse adam == dense adam when every row is touched every
+    step (per-row pows advance in lockstep with the global pow)."""
+    from paddle_tpu.distributed.pserver import _OptimizerState
+
+    rng = np.random.default_rng(1)
+    n, d = 12, 4
+    p_dense = rng.normal(size=(n, d)).astype(np.float32)
+    p_sparse = p_dense.copy()
+    os_d = _OptimizerState("adam", 0.01, {})
+    os_s = _OptimizerState("adam", 0.01, {})
+    for _ in range(5):
+        g = rng.normal(size=(n, d)).astype(np.float32)
+        p_dense = os_d.step(p_dense, g)
+        os_s.step_rows(p_sparse, np.arange(n), g)
+    np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_rows_lazy_per_row_state():
+    """Rows touched at different rates carry their OWN bias correction:
+    row 5 touched 3x must equal a dense adam run of 3 steps on that row
+    alone; untouched rows stay bit-identical."""
+    from paddle_tpu.distributed.pserver import _OptimizerState
+
+    rng = np.random.default_rng(2)
+    n, d = 8, 3
+    p = rng.normal(size=(n, d)).astype(np.float32)
+    p0 = p.copy()
+    os_s = _OptimizerState("adam", 0.05, {})
+    grads = [rng.normal(size=(1, d)).astype(np.float32) for _ in range(3)]
+    for g in grads:
+        os_s.step_rows(p, np.array([5]), g)
+    # dense single-row reference
+    ref = p0[5:6].copy()
+    os_d = _OptimizerState("adam", 0.05, {})
+    for g in grads:
+        ref = os_d.step(ref, g)
+    np.testing.assert_allclose(p[5:6], ref, rtol=1e-5, atol=1e-6)
+    mask = np.ones(n, bool)
+    mask[5] = False
+    np.testing.assert_array_equal(p[mask], p0[mask])
+
+
+def test_sparse_rows_generic_optimizer_and_merge():
+    """The pow-free path runs the registered op impl on row slices
+    (momentum), and duplicate rows merge-add first (SelectedRows merge);
+    negative rows (padding) are dropped."""
+    from paddle_tpu.distributed.pserver import _OptimizerState
+
+    p = np.zeros((4, 2), np.float32)
+    st = _OptimizerState("momentum", 1.0, {"mu": 0.5})
+    st.step_rows(p, np.array([1, 1, -1]),
+                 np.array([[1., 1.], [2., 2.], [9., 9.]], np.float32))
+    # merged grad = 3 -> velocity 3 -> p = -3
+    np.testing.assert_allclose(p[1], [-3., -3.])
+    np.testing.assert_array_equal(p[0], [0., 0.])
+    st.step_rows(p, np.array([1]), np.ones((1, 2), np.float32))
+    # velocity = 0.5*3 + 1 = 2.5 -> p = -5.5
+    np.testing.assert_allclose(p[1], [-5.5, -5.5])
+
+
+def test_pserver_dense_adamax_and_proximal():
+    """Every optimizer the transpiler routes to the pserver has dense
+    state slots (adamax/proximal_* were missing)."""
+    for opt, attrs in [("adamax", {}), ("proximal_gd", {}),
+                       ("proximal_adagrad", {})]:
+        ps = ParameterServer(num_trainers=1, sync=False)
+        w0 = np.ones(3, np.float32)
+        ps.init_param("w", w0, optimizer=opt, lr=0.1, attrs=attrs)
+        ps.finish_init_params()
+        ps.send_grad("w", np.ones(3, np.float32))
+        w1 = ps.get_param("w")
+        assert np.isfinite(w1).all() and np.all(w1 < w0), (opt, w1)
+
+
+def test_sparse_rows_handles_readonly_param():
+    """np.asarray views of jax Arrays are read-only and pickle PRESERVES
+    that flag — a sparse update on a param that arrived as such a view
+    must copy, not crash (caught driving the RPC path end-to-end)."""
+    from paddle_tpu.distributed.pserver import _OptimizerState
+
+    p = np.zeros((4, 2), np.float32)
+    p.setflags(write=False)
+    st = _OptimizerState("adam", 0.1, {})
+    out = st.step_rows(p, np.array([1]), np.ones((1, 2), np.float32))
+    assert out.flags.writeable
+    assert np.all(out[1] < 0)
+
+
+def test_pserver_sparse_send_respects_configured_optimizer():
+    """send_sparse_grad no longer hardcodes SGD: an adagrad server's
+    sparse update uses the adagrad rule."""
+    ps = ParameterServer(num_trainers=1, sync=False)
+    ps.init_param("emb", np.ones((4, 2), np.float32),
+                  optimizer="adagrad", lr=1.0, attrs={"epsilon": 1e-6})
+    ps.finish_init_params()
+    g = np.full((1, 2), 2.0, np.float32)
+    ps.send_sparse_grad("emb", np.array([2]), g)
+    # adagrad: moment = 4, update = 2/sqrt(4) = 1 -> 1 - 1 = 0
+    np.testing.assert_allclose(ps.get_param("emb")[2], [0., 0.], atol=1e-5)
+    np.testing.assert_allclose(ps.get_param("emb")[0], [1., 1.])
+
+
+def test_ctr_dnn_distributed_sparse_matches_local_adam():
+    """CTR-DNN via the block-sharded sparse pserver path vs the SAME
+    program trained locally: with every vocab row touched each step the
+    lazy sparse adam must match local dense adam (VERDICT round-2 item 3
+    acceptance).  Embeddings go through prefetch + send_sparse_grad;
+    the dense tower through blockwise send_grads."""
+    from paddle_tpu.models import ctr_dnn
+
+    vocab, emb, slots = 16, 4, 2
+    outs = ctr_dnn.build(sparse_feature_dim=vocab, num_slots=slots,
+                         embedding_size=emb, dense_dim=3, hidden=(8,),
+                         learning_rate=1e-2)
+    main = pt.default_main_program()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.scope.global_scope()
+    emb_params = [p.name for p in main.all_parameters()
+                  if tuple(p.shape) == (vocab, emb)]
+    assert len(emb_params) == slots
+    snapshot = {p.name: np.array(scope.get(p.name))
+                for p in main.all_parameters()}
+
+    rng = np.random.default_rng(0)
+    batch = vocab  # every row of every slot appears in every batch
+    feeds = []
+    for _ in range(4):
+        feed = {"dense_feature":
+                rng.normal(size=(batch, 3)).astype(np.float32),
+                "click": rng.integers(0, 2, (batch, 1)).astype(np.int64)}
+        for s in range(slots):
+            ids = np.arange(vocab)
+            rng.shuffle(ids)
+            feed[f"slot_{s}"] = ids.reshape(-1, 1).astype(np.int64)
+        feeds.append(feed)
+
+    # local run
+    for feed in feeds:
+        exe.run(main, feed=feed, fetch_list=[outs["avg_cost"]])
+    local = {n: np.array(scope.get(n)) for n in snapshot}
+
+    # reset scope, distributed run (4 servers, sparse embeddings)
+    for n, v in snapshot.items():
+        scope.set(n, v.copy())
+    t = DistributeTranspiler()
+    t.transpile(main, pservers=4, trainers=1)
+    servers = [ParameterServer(index=i, num_trainers=1) for i in range(4)]
+    dt = DistributedTrainer(
+        t, exe, servers, learning_rate=1e-2,
+        sparse_params={p: f"slot_{i}" for i, p in enumerate(emb_params)})
+    dt.init_params_on_pservers()
+    for feed in feeds:
+        dt.train_step(feed)
+    # every param (sparse and dense) lives on the servers; fetch back
+    for name in snapshot:
+        got = dt.client.get_params([name])[name]
+        np.testing.assert_allclose(
+            got, local[name], rtol=2e-4, atol=2e-5,
+            err_msg=f"param {name} diverged between local and sparse-PS")
+
+
+def _multihost_env(n_virtual=2):
+    env = dict(os.environ)
+    for k in list(env):
+        if "AXON" in k or k.startswith("TPU_") or k.startswith("PJRT_"):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONSAFEPATH", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n_virtual}")
+    # bit-identical runs need load-independent reduction splits: XLA CPU
+    # partitions multithreaded reductions by available threads, so a busy
+    # machine changes summation order and the last few mantissa bits
+    flags.append("--xla_cpu_multi_thread_eigen=false")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["OMP_NUM_THREADS"] = "1"
+    return env
+
+
+def _run_multihost_phase(mode, ckpt_dir, env):
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "multihost_runner.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, runner, coordinator, "2", str(i), mode,
+             str(ckpt_dir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"{mode} rank {i} failed:\n{out}"
+    oks = [[l for l in out.splitlines()
+            if l.startswith("MULTIHOST_CKPT_OK")] for out in outs]
+    assert all(len(o) == 1 for o in oks), outs
+    return [o[0].split()[2:] for o in oks]  # [loss=..., state=...] per rank
+
+
+def test_multihost_sharded_checkpoint_resume(tmp_path):
+    """Multi-host-safe checkpoint of cross-process PARTITIONED state
+    (round-2 VERDICT item 5): a 2-process run whose fc weight is
+    tp-sharded across the processes saves at step 1 (one shard file per
+    process), the processes die, fresh processes restore (each reading
+    only ITS shard) and continue — final params bit-identical to an
+    uninterrupted 3-step run on both ranks."""
+    env = _multihost_env(2)
+    ckpt = tmp_path / "ckpt"
+    ref = _run_multihost_phase("ckpt_ref", ckpt, env)
+    saved = _run_multihost_phase("ckpt_save", ckpt, env)
+    # the checkpoint really is per-process shard files
+    files = os.listdir(ckpt)
+    assert any(".shard0." in f for f in files), files
+    assert any(".shard1." in f for f in files), files
+    resumed = _run_multihost_phase("ckpt_resume", ckpt, env)
+    # all three runs agree on final loss and state digest, per rank
+    assert ref == saved == resumed, (ref, saved, resumed)
+    # and the replicated loss agrees ACROSS ranks (one global SPMD
+    # computation, not two process-local ones)
+    assert ref[0][0] == ref[1][0], ref
+
+
+def test_late_attach_client_recovers_block_plan():
+    """A client that never called init_params (eval-only trainer)
+    rebuilds the block plan from the hash server's param meta and
+    fetches/updates a block-sharded param correctly."""
+    servers = [ParameterServer(index=i, num_trainers=1) for i in range(4)]
+    first = PServerClient(servers, min_block_elems=64)
+    w = np.arange(100 * 8, dtype=np.float32).reshape(100, 8)
+    first.init_params({"w": w}, optimizer="sgd", lr=0.5)
+    # the late client has a DIFFERENT (default) block-size knob: the plan
+    # must come from the initializer's recorded meta, not local config
+    late = PServerClient(servers)
+    np.testing.assert_array_equal(late.get_params(["w"])["w"], w)
+    rows = late.get_param_rows("w", np.array([0, 50, 99]))
+    np.testing.assert_array_equal(rows, w[[0, 50, 99]])
+    # empty query returns (0, row_width) once the plan/shape is known
+    empty = late.get_param_rows("w", np.array([], np.int64))
+    assert empty.shape == (0, 8)
+    with pytest.raises(IndexError):
+        late.send_sparse_grad("w", np.array([100]),
+                              np.ones((1, 8), np.float32))
+    late.close()
+    first.close()
+
+
+def test_client_handles_scalar_and_aliasing():
+    """0-d (scalar) params go whole (no row slicing), and in-process
+    servers must COPY init values — a sparse update must never mutate
+    the caller's original array."""
+    servers = [ParameterServer(index=i, num_trainers=1) for i in range(2)]
+    client = PServerClient(servers, min_block_elems=4)
+    w = np.zeros((8, 2), np.float32)
+    s = np.float32(2.0)
+    client.init_params({"w": w, "step": s}, optimizer="sgd", lr=1.0)
+    client.send_grads({"step": np.float32(1.0)})
+    np.testing.assert_allclose(client.get_params(["step"])["step"], 1.0)
+    client.send_sparse_grad("w", np.array([3]), np.ones((1, 2), np.float32))
+    np.testing.assert_array_equal(w, np.zeros((8, 2), np.float32))
+    np.testing.assert_allclose(client.get_params(["w"])["w"][3], [-1, -1])
+    client.close()
+
+
+def test_checkpoint_completion_markers(tmp_path):
+    """A checkpoint missing a process's completion marker (writer died
+    mid-save) must refuse to load rather than restore torn state."""
+    x = layers.data("x", shape=[3])
+    pred = layers.fc(input=x, size=2)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    d = str(tmp_path / "ck")
+    pt.io.save_persistables(exe, d, pt.default_main_program())
+    # healthy load works
+    pt.io.load_persistables(exe, d, pt.default_main_program())
+    os.remove(os.path.join(d, "__done0__"))
+    with pytest.raises(IOError, match="incomplete checkpoint"):
+        pt.io.load_persistables(exe, d, pt.default_main_program())
+
+
+def test_recovered_legacy_whole_param_server():
+    """Servers recovered from a pre-block-sharding checkpoint hold params
+    WHOLE under bare names: a round-3 client must detect the meta refusal
+    and route whole, not to block keys that don't exist."""
+    # pick a name whose hash server is index 0
+    name = next(n for n in (f"w{i}" for i in range(64))
+                if assign_server(n, 4) == 0)
+    legacy = ParameterServer(index=0, num_trainers=1, sync=False)
+    legacy.init_param(name, np.zeros((100, 8), np.float32),
+                      optimizer="sgd", lr=0.5)
+    legacy.finish_init_params()  # = recovered: whole param, no meta
+    servers = [legacy] + [ParameterServer(index=i, num_trainers=1,
+                                          sync=False) for i in range(1, 4)]
+    client = PServerClient(servers, min_block_elems=64)
+    client.init_params({name: np.zeros((100, 8), np.float32)},
+                       optimizer="sgd", lr=0.5)
+    client.send_grads({name: np.ones((100, 8), np.float32)})
+    np.testing.assert_allclose(client.get_params([name])[name], -0.5)
