@@ -25,22 +25,223 @@ def _is_persistable(var):
     return var.persistable
 
 
+def _multiproc_ids():
+    """(process_index, process_count) without initializing a jax backend
+    in a numpy-only program (probe only if jax is already imported)."""
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            return (sys.modules["jax"].process_index(),
+                    sys.modules["jax"].process_count())
+        except Exception:
+            pass
+    return 0, 1
+
+
+def _check_write_once(dirname, proc):
+    """Raise if this process already began/finished writing a checkpoint
+    into ``dirname`` (multi-process dirs are write-once)."""
+    for sentinel in (f"__begun{proc}__", f"__done{proc}__"):
+        if os.path.exists(os.path.join(dirname, sentinel)):
+            raise ValueError(
+                f"{dirname} already holds (part of) a checkpoint: "
+                f"multi-process checkpoint directories are write-once — "
+                f"save each step to a fresh directory "
+                f"(e.g. f'ckpt/step_{{n}}')")
+
+
+class _ShardedSnap:
+    """Host snapshot of a cross-process PARTITIONED jax.Array: this
+    process's unique shards (index -> ndarray) + global shape/dtype.
+    Written as one ``.shard<p>.npz`` per process (the Go pserver's
+    file-per-shard checkpoint layout, go/pserver/service.go:342, carried
+    to SPMD state)."""
+
+    def __init__(self, shards, shape, dtype, nprocs, proc):
+        self.shards = shards      # {((start, stop), ...): ndarray}
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+        self.nprocs = nprocs
+        self.proc = proc
+
+
+def _index_key(idx, shape):
+    """Normalize a tuple-of-slices shard index to a hashable key."""
+    return tuple(
+        (s.start or 0, dim if s.stop is None else s.stop)
+        for s, dim in zip(idx, shape)
+    )
+
+
+def _host_snapshot(val):
+    """Device value -> host snapshot: ndarray for addressable/replicated
+    arrays, _ShardedSnap for cross-process partitioned ones (np.asarray
+    would throw on those — the round-2 multi-host checkpoint gap)."""
+    try:
+        import jax
+    except ImportError:
+        return np.asarray(val)
+    if not isinstance(val, jax.Array):
+        return np.asarray(val)
+    if val.is_fully_addressable or val.is_fully_replicated:
+        return np.asarray(val)
+    shards = {}
+    for s in val.addressable_shards:
+        key = _index_key(s.index, val.shape)
+        if key not in shards:  # dedupe replicas across local devices
+            shards[key] = np.asarray(s.data)
+    return _ShardedSnap(shards, val.shape, val.dtype,
+                        jax.process_count(), jax.process_index())
+
+
 def _write_snapshot(dirname, snap):
-    """Write a {name: ndarray} snapshot as one .npy per tensor + CRC
-    manifest — THE on-disk checkpoint format (shared by save_vars and
-    AsyncCheckpointer so the two writers cannot drift)."""
+    """Write a {name: ndarray | _ShardedSnap} snapshot as one .npy per
+    dense tensor + one .shard<p>.npz per process for partitioned tensors,
+    with CRC manifests — THE on-disk checkpoint format (shared by
+    save_vars and AsyncCheckpointer so the two writers cannot drift).
+
+    Multi-process protocol: every process calls this with the same var
+    set; process 0 writes the dense files + the main manifest, every
+    process writes its own shard files + a per-process CRC sidecar, and
+    every process writes a ``__done<p>__`` completion marker LAST (its
+    own marker deleted first) — ``load_vars`` refuses a checkpoint whose
+    markers are incomplete, so a crash mid-overwrite can never be read
+    as valid torn state.  Callers must barrier across processes after
+    (``AsyncCheckpointer.wait()`` + a collective) before treating the
+    checkpoint as published."""
     os.makedirs(dirname, exist_ok=True)
-    manifest = {}
+    # the process id must come from the runtime, NOT from the snapshot
+    # contents: in an all-replicated multi-process job (plain dp) there
+    # is no _ShardedSnap, and every process writing the dense files as
+    # "proc 0" would race on the same paths
+    proc, nprocs = _multiproc_ids()
+    marker = os.path.join(dirname, f"__done{proc}__")
+    if nprocs > 1:
+        # multi-process checkpoint dirs are WRITE-ONCE: with no cross-
+        # process barrier inside the writer, overwriting in place could
+        # mix generations while every marker still looks complete (a
+        # lagging process may not even have started).  Each process
+        # checks only files IT owns — race-free against same-save peers
+        # — and writes a "begun" sentinel BEFORE any data file, so even
+        # a save that crashed at its first write blocks a retry into the
+        # same directory.
+        _check_write_once(dirname, proc)
+        if proc == 0 and os.path.exists(
+                os.path.join(dirname, "__manifest__.pkl")):
+            raise ValueError(
+                f"{dirname} already holds (part of) a checkpoint: "
+                f"multi-process checkpoint directories are write-once — "
+                f"save each step to a fresh directory")
+        with open(os.path.join(dirname, f"__begun{proc}__"), "w") as f:
+            f.write("begun")
+    elif os.path.exists(marker):
+        os.remove(marker)  # single-proc overwrite: invalidate first
+    manifest = {"__nprocs__": nprocs}
+    shard_sidecar = {}
     for name, arr in snap.items():
         fname = name.replace("/", "__")
         path = os.path.join(dirname, fname)
-        np.save(path + ".npy", arr)
-        with open(path + ".npy", "rb") as f:
-            crc = zlib.crc32(f.read())
-        manifest[name] = {"file": fname + ".npy", "crc32": crc,
-                          "shape": list(arr.shape), "dtype": str(arr.dtype)}
-    with open(os.path.join(dirname, "__manifest__.pkl"), "wb") as f:
-        pickle.dump(manifest, f)
+        if isinstance(arr, _ShardedSnap):
+            sfile = f"{fname}.shard{arr.proc}.npz"
+            payload = {}
+            for i, (key, data) in enumerate(sorted(arr.shards.items())):
+                payload[f"data{i}"] = data
+                payload[f"index{i}"] = np.asarray(key, np.int64)
+            np.savez(os.path.join(dirname, sfile), **payload)
+            with open(os.path.join(dirname, sfile), "rb") as f:
+                shard_sidecar[name] = {"file": sfile,
+                                       "crc32": zlib.crc32(f.read())}
+            manifest[name] = {"sharded": True,
+                              "file_pattern": fname + ".shard{p}.npz",
+                              "nprocs": arr.nprocs,
+                              "shape": list(arr.shape),
+                              "dtype": arr.dtype}
+            continue
+        if proc == 0:
+            np.save(path + ".npy", arr)
+            with open(path + ".npy", "rb") as f:
+                crc = zlib.crc32(f.read())
+            manifest[name] = {"file": fname + ".npy", "crc32": crc,
+                              "shape": list(arr.shape),
+                              "dtype": str(arr.dtype)}
+    if shard_sidecar or proc != 0:
+        with open(os.path.join(dirname, f"__shards{proc}__.pkl"),
+                  "wb") as f:
+            pickle.dump(shard_sidecar, f)
+    if proc == 0:
+        with open(os.path.join(dirname, "__manifest__.pkl"), "wb") as f:
+            pickle.dump(manifest, f)
+    with open(marker, "w") as f:
+        f.write("ok")
+
+
+def _load_sharded(dirname, name, meta, current):
+    """Restore a partitioned var.  With a live same-topology sharded
+    array in the scope (``current``), each process loads only ITS shard
+    file and reassembles device buffers; otherwise (e.g. single-process
+    inspection) all shard files are read and assembled into one dense
+    ndarray."""
+    import jax
+
+    shape = tuple(meta["shape"])
+    try:
+        dtype = np.dtype(meta["dtype"])
+    except TypeError:
+        # numpy can't parse jax-only dtype names ('bfloat16'); ml_dtypes
+        # (a jax dependency) supplies them
+        import ml_dtypes
+
+        dtype = np.dtype(getattr(ml_dtypes, meta["dtype"]))
+
+    def proc_crc(p):
+        sidecar_path = os.path.join(dirname, f"__shards{p}__.pkl")
+        if not os.path.exists(sidecar_path):
+            return None
+        with open(sidecar_path, "rb") as f:
+            sc = pickle.load(f)
+        return sc.get(name, {}).get("crc32")
+
+    def read_proc(p, check_crc=None):
+        fname = meta["file_pattern"].replace("{p}", str(p))
+        path = os.path.join(dirname, fname)
+        with open(path, "rb") as f:
+            data = f.read()
+        if check_crc is not None and zlib.crc32(data) != check_crc:
+            raise IOError(f"checksum mismatch for shard of {name}: {path}")
+        npz = np.load(path, allow_pickle=False)
+        out = {}
+        n = len(npz.files) // 2
+        for i in range(n):
+            key = tuple(map(tuple, npz[f"index{i}"]))
+            out[key] = npz[f"data{i}"]
+        return out
+
+    if (isinstance(current, jax.Array)
+            and not current.is_fully_addressable
+            and current.shape == shape
+            and meta["nprocs"] == jax.process_count()):
+        proc = jax.process_index()
+        shards = read_proc(proc, proc_crc(proc))
+        sharding = current.sharding
+        idx_map = sharding.addressable_devices_indices_map(shape)
+        keys = {_index_key(idx, shape) for idx in idx_map.values()}
+        if keys <= set(shards):
+            bufs = [
+                jax.device_put(shards[_index_key(idx, shape)], dev)
+                for dev, idx in idx_map.items()
+            ]
+            return jax.make_array_from_single_device_arrays(
+                shape, sharding, bufs)
+        # the live sharding's layout differs from the one saved (e.g.
+        # the partition axis moved): fall through to dense assembly
+    # dense assembly from every process's file (CRC-checked like the
+    # dense .npy path)
+    out = np.zeros(shape, dtype)
+    for p in range(meta["nprocs"]):
+        for key, data in read_proc(p, proc_crc(p)).items():
+            out[tuple(slice(a, b) for a, b in key)] = data
+    return out
 
 
 def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
@@ -53,7 +254,7 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
         val = scope.find_var(var.name)
         if val is None:
             continue
-        snap[var.name] = np.asarray(val)
+        snap[var.name] = _host_snapshot(val)
     _write_snapshot(dirname, snap)
 
 
@@ -80,9 +281,23 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
         dirname = dirname + ".old"
     with open(os.path.join(dirname, "__manifest__.pkl"), "rb") as f:
         manifest = pickle.load(f)
+    if "__nprocs__" in manifest:  # marker-protocol checkpoints (round 3+)
+        missing = [
+            p for p in range(manifest["__nprocs__"])
+            if not os.path.exists(os.path.join(dirname, f"__done{p}__"))
+        ]
+        if missing:
+            raise IOError(
+                f"incomplete checkpoint {dirname}: completion markers "
+                f"missing for process(es) {missing} — a writer crashed "
+                f"mid-save; restore from an older checkpoint")
     for var in vars:
         meta = manifest.get(var.name)
         if meta is None:
+            continue
+        if meta.get("sharded"):
+            scope.set(var.name, _load_sharded(
+                dirname, var.name, meta, scope.find_var(var.name)))
             continue
         path = os.path.join(dirname, meta["file"])
         with open(path, "rb") as f:
@@ -166,6 +381,8 @@ class AsyncCheckpointer:
 
         self._q = queue.Queue(maxsize=max_pending)
         self._errors = []
+        self._pending_dirs = set()  # dirs queued but not yet written
+        self._pending_lock = threading.Lock()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -181,12 +398,22 @@ class AsyncCheckpointer:
             except Exception as e:  # surfaced on next save()/close()
                 self._errors.append(e)
             finally:
+                with self._pending_lock:
+                    self._pending_dirs.discard(dirname)
                 self._q.task_done()
 
     @staticmethod
     def _write(dirname, snap):
         import shutil
 
+        multiproc = _multiproc_ids()[1] > 1
+        if multiproc:
+            # cross-process checkpoint: skip the atomic-rename publish (N
+            # processes renaming the same dir would race); the checkpoint
+            # counts as published only after the caller's barrier
+            # (wait() + a collective — tests/multihost_runner.py pattern)
+            _write_snapshot(dirname, snap)
+            return
         tmp = dirname + ".tmp"
         if os.path.exists(tmp):  # leftovers from a crashed prior run
             shutil.rmtree(tmp)
@@ -214,8 +441,26 @@ class AsyncCheckpointer:
 
     def save(self, dirname, main_program=None, scope=None):
         """Snapshot now, write in the background.  Blocks only if
-        ``max_pending`` earlier checkpoints are still being written."""
+        ``max_pending`` earlier checkpoints are still being written.
+
+        Multi-process jobs must save each step to a FRESH directory
+        (write-once protocol); reusing one raises here, synchronously,
+        rather than one checkpoint interval late in the worker."""
         self._raise_pending()
+        proc, nprocs = _multiproc_ids()
+        if nprocs > 1:
+            _check_write_once(dirname, proc)
+            # the on-disk sentinel only appears once the worker runs; a
+            # second save() racing ahead of it must fail HERE, not one
+            # interval late in the worker
+            with self._pending_lock:
+                if dirname in self._pending_dirs:
+                    raise ValueError(
+                        f"{dirname} already queued for checkpointing: "
+                        f"multi-process checkpoint directories are "
+                        f"write-once — save each step to a fresh "
+                        f"directory")
+                self._pending_dirs.add(dirname)
         program = main_program or default_main_program()
         scope = scope or global_scope()
         snap = {}
@@ -225,7 +470,7 @@ class AsyncCheckpointer:
             val = scope.find_var(var.name)
             if val is None:
                 continue
-            snap[var.name] = np.asarray(val)
+            snap[var.name] = _host_snapshot(val)
         self._q.put((dirname, snap))
 
     def wait(self):
