@@ -113,7 +113,8 @@ def _pipeline_ticks(stage_fn, params, ingest, emit, acc0, wire_proto,
 
 
 def pipeline(stage_fn, stacked_params, x, mesh, axis_name="pp",
-             num_microbatches=None, batch_axis=None, virtual_stages=1):
+             num_microbatches=None, batch_axis=None, virtual_stages=1,
+             wire_spec=None):
     """Run stacked copies of ``stage_fn`` as a pipeline.
 
     stage_fn(params, h) -> h        one stage, shape-preserving
@@ -125,9 +126,17 @@ def pipeline(stage_fn, stacked_params, x, mesh, axis_name="pp",
                                     the microbatch dim over (dp×pp)
     virtual_stages                  v: stages per device (interleaved
                                     round-robin placement when > 1)
+    wire_spec                       optional tuple of mesh-axis names (or
+                                    None) for x's dims AFTER batch — e.g.
+                                    ``("sp", None)`` seq-shards a
+                                    [batch, t, d] wire so stage_fn sees
+                                    [mb, t/sp, d] and can run ring
+                                    attention over the manual ``sp`` axis
+                                    (pp x sp composition); overrides
+                                    batch_axis-only sharding
 
     Returns ``[batch, ...]`` outputs (replicated over ``pp``, sharded
-    over ``batch_axis`` if given).
+    over ``batch_axis``/``wire_spec`` if given).
     """
     pp = mesh.shape[axis_name]
     v = virtual_stages
@@ -152,7 +161,10 @@ def pipeline(stage_fn, stacked_params, x, mesh, axis_name="pp",
             jnp.where(idx == pp - 1, out_buf, jnp.zeros_like(out_buf)),
             axis_name)
 
-    xspec = P(None, batch_axis) if batch_axis else P()
+    if wire_spec is not None:
+        xspec = P(None, batch_axis, *wire_spec)
+    else:
+        xspec = P(None, batch_axis) if batch_axis else P()
     fn = jax.shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(None, axis_name), xspec), out_specs=xspec,

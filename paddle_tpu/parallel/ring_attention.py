@@ -101,46 +101,54 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
     if impl not in ("dense", "flash"):
         raise ValueError(f"unknown ring_attention impl {impl!r}; "
                          f"choose 'dense' or 'flash'")
-    sp = mesh.shape[axis_name]
     if impl == "flash":
         return _ring_attention_flash(q, k, v, mesh, axis_name, causal,
                                      block_q, block_k)
 
-    def local_fn(q_blk, k_blk, v_blk):
-        # q_blk etc: [b, t/sp, h, d] local shards
-        b, tl, h, d = q_blk.shape
-        my_idx = jax.lax.axis_index(axis_name)
-
-        def step(carry, i):
-            o, m, l, kk, vv = carry
-            src_idx = (my_idx - i) % sp  # whose K/V block we hold now
-            bias = None
-            if causal:
-                qpos = (my_idx * tl + jnp.arange(tl))[:, None]
-                kpos = (src_idx * tl + jnp.arange(tl))[None, :]
-                bias = jnp.where(qpos >= kpos, 0.0, -1e30)[None, None]
-            o2, m2, l2 = _attn_block(q_blk, kk, vv, bias=bias)
-            o, m, l = _merge(o, m, l, o2, m2, l2)
-            # rotate K/V around the ring (overlaps with next block's compute)
-            perm = [(j, (j + 1) % sp) for j in range(sp)]
-            kk = jax.lax.ppermute(kk, axis_name, perm)
-            vv = jax.lax.ppermute(vv, axis_name, perm)
-            return (o, m, l, kk, vv), None
-
-        o0 = jnp.zeros_like(q_blk)
-        m0 = jnp.full((b, h, tl), -1e30, jnp.float32)
-        l0 = jnp.zeros((b, h, tl), jnp.float32)
-        (o, m, l, _, _), _ = jax.lax.scan(
-            step, (o0, m0, l0, k_blk, v_blk), jnp.arange(sp)
-        )
-        return _finalize(o, m, l)
-
+    sp = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
-        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        lambda qb, kb, vb: ring_attention_local(
+            qb, kb, vb, sp, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+def ring_attention_local(q_blk, k_blk, v_blk, sp, axis_name="sp",
+                         causal=False):
+    """The ring's per-device body, for callers ALREADY inside a
+    ``shard_map`` that has ``axis_name`` as a manual mesh axis — e.g. an
+    attention stage inside ``parallel.pipeline`` (pp x sp composition).
+    q_blk/k_blk/v_blk are this device's [b, t/sp, h, d] shards; ``sp`` is
+    the ring size (``mesh.shape[axis_name]``)."""
+    b, tl, h, d = q_blk.shape
+    my_idx = jax.lax.axis_index(axis_name)
+
+    def step(carry, i):
+        o, m, l, kk, vv = carry
+        src_idx = (my_idx - i) % sp  # whose K/V block we hold now
+        bias = None
+        if causal:
+            qpos = (my_idx * tl + jnp.arange(tl))[:, None]
+            kpos = (src_idx * tl + jnp.arange(tl))[None, :]
+            bias = jnp.where(qpos >= kpos, 0.0, -1e30)[None, None]
+        o2, m2, l2 = _attn_block(q_blk, kk, vv, bias=bias)
+        o, m, l = _merge(o, m, l, o2, m2, l2)
+        # rotate K/V around the ring (overlaps with next block's compute)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        return (o, m, l, kk, vv), None
+
+    o0 = jnp.zeros_like(q_blk)
+    m0 = jnp.full((b, h, tl), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, tl), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k_blk, v_blk), jnp.arange(sp)
+    )
+    return _finalize(o, m, l)
 
 
 def _ring_attention_flash(q, k, v, mesh, axis_name, causal, block_q,
