@@ -32,6 +32,7 @@ from . import unique_name
 from .dtypes import convert_dtype
 
 LENGTH_SUFFIX = "@LENGTH"
+SUBLENGTH_SUFFIX = "@SUBLENGTH"
 GRAD_SUFFIX = "@GRAD"
 
 
@@ -72,6 +73,10 @@ class Variable:
     def length_var(self):
         """The shadow sequence-length variable (lod replacement)."""
         return self.block.length_var(self)
+
+    def sub_length_var(self):
+        """The shadow inner-level length variable (2-level lod)."""
+        return self.block.sub_length_var(self)
 
     def __repr__(self):
         return (
@@ -237,6 +242,26 @@ class Block:
         lv = Variable(
             owner, name=name, shape=(batch,), dtype="int32", is_data=var.is_data,
             stop_gradient=True,
+        )
+        owner.vars[name] = lv
+        return lv
+
+    def sub_length_var(self, var):
+        """Create/find the shadow ``<name>@SUBLENGTH`` int32 [batch, s]
+        variable — the INNER level's per-sub-sequence lengths of a
+        2-level (nested) sequence batch [b, s, t, ...] (reference
+        ``Argument.subSequenceStartPositions``, Argument.h:84-86;
+        ``lod_tensor.h:58``'s second LoD level)."""
+        name = var.name + SUBLENGTH_SUFFIX
+        existing = self._find_var(name)
+        if existing is not None:
+            return existing
+        batch = var.shape[0] if var.shape else -1
+        s = var.shape[1] if len(var.shape) > 1 else -1
+        owner = var.block
+        lv = Variable(
+            owner, name=name, shape=(batch, s), dtype="int32",
+            is_data=var.is_data, stop_gradient=True,
         )
         owner.vars[name] = lv
         return lv

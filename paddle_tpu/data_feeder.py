@@ -9,7 +9,7 @@ same information as LoD, static shapes.
 
 import numpy as np
 
-from .core.program import LENGTH_SUFFIX
+from .core.program import LENGTH_SUFFIX, SUBLENGTH_SUFFIX
 
 
 def _round_up(n, m):
@@ -30,7 +30,9 @@ class DataFeeder:
         result = {}
         for i, var in enumerate(self.feed_vars):
             col = [row[i] for row in rows]
-            if getattr(var, "lod_level", 0) > 0:
+            if getattr(var, "lod_level", 0) > 1:
+                self._feed_nested(var, col, result)
+            elif getattr(var, "lod_level", 0) > 0:
                 arrs = [np.asarray(c, dtype=var.dtype) for c in col]
                 lens = np.asarray([a.shape[0] for a in arrs], dtype=np.int32)
                 max_len = max(1, _round_up(int(lens.max()), self.pad_multiple))
@@ -56,3 +58,41 @@ class DataFeeder:
                     arr = arr[..., None]  # fluid's trailing [.,1] label shape
                 result[var.name] = arr
         return result
+
+    def _feed_nested(self, var, col, result):
+        """2-level (nested) rows: each sample is a list of sub-sequences,
+        each sub-sequence a list/array of items — padded to
+        [b, max_subseqs, max_items, ...] with ``@LENGTH`` [b] (sub-seqs
+        per sample) and ``@SUBLENGTH`` [b, s] (items per sub-seq)."""
+        samples = [
+            [np.asarray(sub, dtype=var.dtype) for sub in sample]
+            for sample in col
+        ]
+        lens = np.asarray([len(s) for s in samples], np.int32)
+        max_s = max(1, _round_up(int(lens.max()), self.pad_multiple))
+        max_t = max([1] + [sub.shape[0] for s in samples for sub in s])
+        max_t = _round_up(max_t, self.pad_multiple)
+        feat = next((s[0].shape[1:] for s in samples if s), ())
+        # declared static dims override BEFORE any allocation, so data,
+        # @LENGTH and @SUBLENGTH always agree on [b, s, t] — but ONLY
+        # when the declared rank actually covers [b, s, t, *feat]; a
+        # feature-only declaration (shape=[d], lod_level=2) must not
+        # have its feature dim misread as the sub-sequence cap
+        declared = list(var.shape)
+        if len(declared) == 3 + len(feat):
+            if declared[1] and declared[1] > 0:
+                max_s = declared[1]
+            if declared[2] and declared[2] > 0:
+                max_t = declared[2]
+        sub_lens = np.zeros((len(samples), max_s), np.int32)
+        for j, sample in enumerate(samples):
+            for k, sub in enumerate(sample[:max_s]):
+                sub_lens[j, k] = sub.shape[0]
+        out = np.zeros((len(samples), max_s, max_t) + feat, dtype=var.dtype)
+        for j, sample in enumerate(samples):
+            for k, sub in enumerate(sample[:max_s]):
+                t = min(sub.shape[0], max_t)
+                out[j, k, :t] = sub[:t]
+        result[var.name] = out
+        result[var.name + LENGTH_SUFFIX] = np.minimum(lens, max_s)
+        result[var.name + SUBLENGTH_SUFFIX] = np.minimum(sub_lens, max_t)

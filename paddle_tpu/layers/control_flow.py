@@ -124,6 +124,12 @@ class StaticRNN:
         self._outputs = []
         self._sub = None
         self._parent = None
+        self._length_name = None
+
+    def set_sequence_length(self, length_var):
+        """Freeze carried states past each sample's length (the LoD
+        semantics: padded steps do not advance memories)."""
+        self._length_name = length_var.name
 
     @contextlib.contextmanager
     def step(self):
@@ -132,17 +138,20 @@ class StaticRNN:
         self._sub = prog.create_block()
         yield
         prog.rollback()
+        attrs = {
+            "sub_block": self._sub.idx,
+            "x_names": self._x_inner,
+            "state_names": self._state_names,
+            "out_names": self._out_names,
+            "reverse": False,
+        }
+        if self._length_name is not None:
+            attrs["length_name"] = self._length_name
         self._parent.append_op(
             type="scan_block",
             inputs={"X": self._x_outer, "Init": self._init_outer},
             outputs={"Out": [o.name for o in self._outputs]},
-            attrs={
-                "sub_block": self._sub.idx,
-                "x_names": self._x_inner,
-                "state_names": self._state_names,
-                "out_names": self._out_names,
-                "reverse": False,
-            },
+            attrs=attrs,
         )
 
     def step_input(self, x):

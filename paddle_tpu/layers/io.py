@@ -11,6 +11,11 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     time from the actual minibatch, like the reference's -1 dim).  With
     ``lod_level > 0`` the variable is a padded sequence batch and its shadow
     ``<name>@LENGTH`` int32 var is created alongside (the LoD replacement).
+    ``lod_level == 2`` declares a NESTED sequence batch [b, s, t, ...]
+    (reference ``lod_tensor.h:58`` two-level LoD /
+    ``Argument.subSequenceStartPositions``): ``@LENGTH`` [b] counts
+    sub-sequences per sample and the additional shadow ``@SUBLENGTH``
+    [b, s] counts items per sub-sequence.
     """
     prog = main_program or default_main_program()
     shape = list(shape)
@@ -26,4 +31,6 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     )
     if lod_level > 0:
         var.length_var()
+    if lod_level > 1:
+        var.sub_length_var()
     return var

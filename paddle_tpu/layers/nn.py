@@ -47,6 +47,11 @@ __all__ = [
     "mul",
     "flash_attention",
     "multi_head_attention",
+    "nested_sequence_pool",
+    "nested_sequence_expand",
+    "nested_sequence_slice",
+    "sub_nested_seq",
+    "nested_rnn",
     "topk",
     "warpctc",
     "ctc_greedy_decoder",
@@ -1455,3 +1460,130 @@ def spp(input, pyramid_height=3, pool_type="max", name=None):
         attrs={"pyramid_height": pyramid_height, "pooling_type": pool_type},
     )
     return out
+
+
+# -- 2-level (nested) sequence layers ----------------------------------------
+def _nested_inputs(inputs, x):
+    """Wire Length + SubLength for a nested (lod_level 2) input."""
+    if getattr(x, "lod_level", 0) > 0:
+        inputs["Length"] = [x.length_var().name]
+    if getattr(x, "lod_level", 0) > 1:
+        inputs["SubLength"] = [x.sub_length_var().name]
+
+
+def _link_nested(out, length_var, sub_length_var):
+    """Mark ``out`` as a nested sequence carrying the given shadows."""
+    out.lod_level = 2
+    out.block.vars[out.name + "@LENGTH"] = length_var
+    out.block.vars[out.name + "@SUBLENGTH"] = sub_length_var
+    return out
+
+
+def nested_sequence_pool(input, pool_type):
+    """Pool the INNER level of a [b, s, t, ...] nested batch ->
+    [b, s, ...] 1-level sequence (lengths = the outer level's)."""
+    helper = LayerHelper("nested_sequence_pool")
+    out = helper.create_tmp_variable(
+        input.dtype, [input.shape[0], input.shape[1]] + list(input.shape[3:]))
+    inputs = {"X": [input.name]}
+    _nested_inputs(inputs, input)
+    helper.append_op(
+        type="nested_sequence_pool", inputs=inputs,
+        outputs={"Out": [out.name]},
+        attrs={"pooltype": pool_type.upper()},
+    )
+    out.lod_level = 1
+    out.block.vars[out.name + "@LENGTH"] = input.length_var()
+    return out
+
+
+def nested_sequence_expand(x, y):
+    """Expand per-sub-seq values x [b, s, ...] over nested y's inner
+    level -> [b, s, t, ...] (masked broadcast)."""
+    helper = LayerHelper("nested_sequence_expand")
+    t = y.shape[2]
+    out = helper.create_tmp_variable(
+        x.dtype, list(x.shape[:2]) + [t] + list(x.shape[2:]))
+    inputs = {"X": [x.name], "Y": [y.name]}
+    _nested_inputs(inputs, y)
+    helper.append_op(type="nested_sequence_expand", inputs=inputs,
+                     outputs={"Out": [out.name]})
+    return _link_nested(out, y.length_var(), y.sub_length_var())
+
+
+def nested_sequence_slice(input, offset, size):
+    """Keep sub-sequences [offset, offset+size) of each sample."""
+    helper = LayerHelper("nested_sequence_slice")
+    out = helper.create_tmp_variable(input.dtype, list(input.shape))
+    out_len = helper.create_tmp_variable("int32", [input.shape[0]],
+                                         stop_gradient=True)
+    out_sub = helper.create_tmp_variable(
+        "int32", [input.shape[0], input.shape[1]], stop_gradient=True)
+    inputs = {"X": [input.name], "Offset": [offset.name],
+              "Size": [size.name]}
+    _nested_inputs(inputs, input)
+    helper.append_op(
+        type="nested_sequence_slice", inputs=inputs,
+        outputs={"Out": [out.name], "OutLength": [out_len.name],
+                 "OutSubLength": [out_sub.name]})
+    return _link_nested(out, out_len, out_sub)
+
+
+def sub_nested_seq(input, selected_indices):
+    """Select sub-sequences by per-sample indices (reference
+    SubNestedSequenceLayer.cpp); negative indices = padding."""
+    helper = LayerHelper("sub_nested_seq")
+    k = selected_indices.shape[1]
+    out = helper.create_tmp_variable(
+        input.dtype, [input.shape[0], k] + list(input.shape[2:]))
+    out_len = helper.create_tmp_variable("int32", [input.shape[0]],
+                                         stop_gradient=True)
+    out_sub = helper.create_tmp_variable("int32", [input.shape[0], k],
+                                         stop_gradient=True)
+    inputs = {"X": [input.name], "Indices": [selected_indices.name]}
+    _nested_inputs(inputs, input)
+    helper.append_op(
+        type="sub_nested_seq", inputs=inputs,
+        outputs={"Out": [out.name], "OutLength": [out_len.name],
+                 "OutSubLength": [out_sub.name]})
+    return _link_nested(out, out_len, out_sub)
+
+
+def nested_rnn(input, size, param_attr=None, bias_attr=None, h_0=None,
+               gate_activation="sigmoid", candidate_activation="tanh",
+               name=None):
+    """Hierarchical GRU over a nested batch [b, s, t, 3d] (input
+    pre-projected to gates, the dynamic_gru convention): the inner RNN
+    runs each sub-sequence booted from the outer state; the outer state
+    advances to the last valid inner hidden.  Returns
+    (inner_hiddens [b, s, t, d], outer_states [b, s, d]); outer_states
+    is a 1-level sequence over the outer lengths."""
+    helper = LayerHelper("nested_rnn", name=name)
+    d = size
+    weight = helper.create_parameter(param_attr, shape=[d, 3 * d],
+                                     dtype=input.dtype)
+    bias = helper.create_parameter(
+        ParamAttr.to_attr(bias_attr) or ParamAttr(), shape=[1, 3 * d],
+        dtype=input.dtype, suffix="b",
+        default_initializer=init_mod.Constant(0.0),
+    )
+    hidden = helper.create_tmp_variable(
+        input.dtype, list(input.shape[:3]) + [d])
+    outer = helper.create_tmp_variable(
+        input.dtype, list(input.shape[:2]) + [d])
+    inputs = {"Input": [input.name], "Weight": [weight.name],
+              "Bias": [bias.name]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    _nested_inputs(inputs, input)
+    helper.append_op(
+        type="nested_rnn", inputs=inputs,
+        outputs={"Hidden": [hidden.name], "OuterHidden": [outer.name]},
+        attrs={"gate_activation": gate_activation,
+               "activation": candidate_activation},
+    )
+    if getattr(input, "lod_level", 0) > 1:
+        _link_nested(hidden, input.length_var(), input.sub_length_var())
+        outer.lod_level = 1
+        outer.block.vars[outer.name + "@LENGTH"] = input.length_var()
+    return hidden, outer

@@ -165,7 +165,11 @@ def scan_block(ctx, block, op, env):
     attrs:   sub_block, x_names (names the per-step slices take inside the
              sub-block), state_names (carried var names, updated by the
              block writing the same name), out_names (per-step outputs to
-             stack), reverse (bool).
+             stack), reverse (bool), length_name (optional: an env var
+             [b] of per-sample sequence lengths — carried states FREEZE
+             on steps at/after a sample's length, the LoD semantics where
+             padded steps do not exist; reference recurrent_op expands
+             exactly len steps per sample).
     """
     program = ctx.program
     sub_blk = program.block(op.attrs["sub_block"])
@@ -175,22 +179,35 @@ def scan_block(ctx, block, op, env):
     state_names = op.attrs.get("state_names", [])
     out_names = op.attrs.get("out_names", [])
     reverse = op.attrs.get("reverse", False)
+    length_name = op.attrs.get("length_name")
 
     xs = {inner: jnp.swapaxes(env[outer], 0, 1) for inner, outer in zip(x_names, x_outer)}
     if reverse:
         xs = {k: v[::-1] for k, v in xs.items()}
     init = {n: env[o] for n, o in zip(state_names, init_outer)}
+    t_axis = next(iter(xs.values())).shape[0]
+    steps = jnp.arange(t_axis)
+    if reverse:
+        steps = steps[::-1]  # step i processes original index t-1-i
 
-    def step(carry, x_slice):
+    def step(carry, inp):
+        x_slice, idx = inp
         local = dict(env)
         local.update(carry)
         local.update(x_slice)
         run_block_ops(ctx, sub_blk, sub_blk.ops, local)
+        if length_name is not None:
+            valid = idx < env[length_name]  # [b]
+            for n in state_names:
+                new, old = local[n], carry[n]
+                m = valid.reshape((-1,) + (1,) * (new.ndim - 1)).astype(
+                    new.dtype)
+                local[n] = m * new + (1 - m) * old
         new_carry = {n: local[n] for n in state_names}
         ys = tuple(local[n] for n in out_names)
         return new_carry, ys
 
-    final, stacked = jax.lax.scan(step, init, xs)
+    final, stacked = jax.lax.scan(step, init, (xs, steps))
     outs = []
     for y in stacked:
         y = jnp.swapaxes(y, 0, 1)

@@ -251,3 +251,135 @@ def ctc_align(Input, Length=None, blank=0, merge_repeated=True, **_):
     new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
     out = jnp.where(time_mask(new_len, t, jnp.bool_), gathered, 0)
     return {"Output": out, "OutputLength": new_len}
+
+
+# -- 2-level (nested) sequences ----------------------------------------------
+# The reference's sequence type is recursively nested (lod_tensor.h:58:
+# LoD = vector of levels; Argument.subSequenceStartPositions, Argument.h:84).
+# TPU-native form: [b, s, t, ...] padded dense + Length [b] (sub-seqs per
+# sample) + SubLength [b, s] (items per sub-seq).
+
+
+def _nested_masks(X, Length, SubLength):
+    b, s, t = X.shape[0], X.shape[1], X.shape[2]
+    if Length is None:
+        Length = jnp.full((b,), s, jnp.int32)
+    if SubLength is None:
+        SubLength = jnp.full((b, s), t, jnp.int32)
+    outer = (jnp.arange(s)[None, :] < Length[:, None])            # [b, s]
+    inner = (jnp.arange(t)[None, None, :] < SubLength[:, :, None])  # [b,s,t]
+    inner = inner & outer[:, :, None]
+    return Length, SubLength, outer, inner
+
+
+@register_op("nested_sequence_pool")
+def nested_sequence_pool(X, Length=None, SubLength=None, pooltype="SUM", **_):
+    """Pool the INNER level of a nested batch: [b, s, t, ...] ->
+    [b, s, ...] (a 1-level sequence whose lengths are the outer Length).
+    The per-sub-seq semantics match sequence_pool (reference
+    SequencePoolLayer at the sub-sequence level /
+    sequence_pool with lod_level 2)."""
+    Length, SubLength, outer, inner = _nested_masks(X, Length, SubLength)
+    b, s, t = X.shape[:3]
+    m = inner.astype(X.dtype).reshape(inner.shape + (1,) * (X.ndim - 3))
+    # outer-padded slots count as EMPTY sub-seqs even when SubLength was
+    # defaulted (MAX's lens>0 guard must zero them like every pooltype)
+    SubLength = jnp.where(outer, SubLength, 0)
+    lens = SubLength.astype(jnp.float32).reshape(
+        (b, s) + (1,) * (X.ndim - 3))
+    pt = pooltype.upper()
+    if pt == "SUM":
+        out = jnp.sum(X * m, axis=2)
+    elif pt == "AVERAGE":
+        out = jnp.sum(X * m, axis=2) / jnp.maximum(lens, 1.0)
+    elif pt == "SQRT":
+        out = jnp.sum(X * m, axis=2) / jnp.sqrt(jnp.maximum(lens, 1.0))
+    elif pt == "MAX":
+        neg = jnp.asarray(-1e38, X.dtype)
+        out = jnp.max(jnp.where(m > 0, X, neg), axis=2)
+        out = jnp.where(lens > 0, out, jnp.zeros_like(out))
+    elif pt == "LAST":
+        idx = jnp.maximum(SubLength - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(
+            X, idx.reshape((b, s, 1) + (1,) * (X.ndim - 3)), axis=2
+        ).squeeze(2)
+        out = out * outer.astype(X.dtype).reshape(
+            (b, s) + (1,) * (X.ndim - 3))
+    elif pt == "FIRST":
+        out = X[:, :, 0] * outer.astype(X.dtype).reshape(
+            (b, s) + (1,) * (X.ndim - 3))
+    else:
+        raise ValueError(f"unknown pooltype {pooltype}")
+    return {"Out": out}
+
+
+@register_op("nested_sequence_expand")
+def nested_sequence_expand(X, Y, Length=None, SubLength=None, **_):
+    """Expand a per-sub-seq tensor [b, s, ...] over Y's inner level:
+    out[b, s, t] = X[b, s] for t < SubLength[b, s], else 0 (the
+    sub-sequence-level SequenceExpandLayer)."""
+    _, _, _, inner = _nested_masks(Y, Length, SubLength)
+    t = Y.shape[2]
+    out = jnp.broadcast_to(
+        X[:, :, None], X.shape[:2] + (t,) + X.shape[2:])
+    m = inner.astype(X.dtype).reshape(inner.shape + (1,) * (X.ndim - 2))
+    return {"Out": out * m}
+
+
+@register_op("nested_sequence_slice")
+def nested_sequence_slice(X, Offset, Size, Length=None, SubLength=None, **_):
+    """Per-sample sub-sequence range selection: sample b keeps sub-seqs
+    [Offset[b], Offset[b]+Size[b]) — nested analog of sequence_slice
+    (reference SequenceSliceLayer on the outer level).  Output stays
+    [b, s, t, ...] with OutLength=Size and sub-lengths gathered."""
+    b, s = X.shape[:2]
+    Offset = Offset.reshape(b).astype(jnp.int32)
+    Size = Size.reshape(b).astype(jnp.int32)
+    pos = jnp.arange(s)[None, :] + Offset[:, None]       # [b, s]
+    # a slot is valid only when inside the requested range AND the
+    # sample's REAL sub-sequence count (out-of-range requests yield
+    # fewer sub-seqs, never a silently duplicated clamp or phantom
+    # padded slots — the reference SequenceSliceLayer bounds-checks)
+    if Length is None:
+        Length = jnp.full((b,), s, jnp.int32)
+    valid = ((jnp.arange(s)[None, :] < Size[:, None])
+             & (pos < Length[:, None]))
+    pos = jnp.where(valid, pos, 0)
+    idx = pos.reshape((b, s) + (1,) * (X.ndim - 2))
+    out = jnp.take_along_axis(X, jnp.broadcast_to(idx, (b, s) + X.shape[2:]),
+                              axis=1)
+    vm = valid.reshape((b, s) + (1,) * (X.ndim - 2)).astype(X.dtype)
+    out = out * vm
+    _, SubLength, _, _ = _nested_masks(X, Length, SubLength)
+    sub = jnp.take_along_axis(SubLength, pos, axis=1) * valid
+    return {"Out": out,
+            "OutLength": jnp.sum(valid, axis=1).astype(jnp.int32),
+            "OutSubLength": sub.astype(jnp.int32)}
+
+
+@register_op("sub_nested_seq")
+def sub_nested_seq(X, Indices, Length=None, SubLength=None, **_):
+    """Select sub-sequences by per-sample indices (reference
+    SubNestedSequenceLayer.cpp): Indices [b, k] picks sentences; negative
+    indices are padding and produce empty sub-seqs.  Output
+    [b, k, t, ...] + OutLength [b] (count of valid picks) +
+    OutSubLength [b, k]."""
+    b, s = X.shape[:2]
+    k = Indices.shape[1]
+    idx = Indices.astype(jnp.int32)
+    if Length is None:
+        Length = jnp.full((b,), s, jnp.int32)
+    # bounds-check like the reference SubNestedSequenceLayer: an index
+    # outside the sample's real sub-sequence count is padding, not data
+    valid = (idx >= 0) & (idx < Length[:, None])
+    safe = jnp.where(valid, idx, 0)
+    gi = safe.reshape((b, k) + (1,) * (X.ndim - 2))
+    out = jnp.take_along_axis(
+        X, jnp.broadcast_to(gi, (b, k) + X.shape[2:]), axis=1)
+    vm = valid.reshape((b, k) + (1,) * (X.ndim - 2)).astype(X.dtype)
+    out = out * vm
+    _, SubLength, _, _ = _nested_masks(X, Length, SubLength)
+    sub = jnp.take_along_axis(SubLength, safe, axis=1) * valid
+    return {"Out": out,
+            "OutLength": jnp.sum(valid, axis=1).astype(jnp.int32),
+            "OutSubLength": sub.astype(jnp.int32)}

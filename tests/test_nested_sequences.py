@@ -1,0 +1,375 @@
+"""Nested (2-level LoD) sequences — the reference's recursively nested
+sequence type (``lod_tensor.h:58`` LoD = vector of levels;
+``Argument.subSequenceStartPositions``, Argument.h:84-86) carried as
+padded [b, s, t, ...] + ``@LENGTH`` [b] + ``@SUBLENGTH`` [b, s].
+
+The hierarchical-RNN golden follows the reference's
+``gserver/tests/sequence_nest_rnn.conf`` / test_RecurrentGradientMachine
+equivalence: a nested RNN whose outer memory boots each sub-sequence's
+inner RNN equals a FLAT RNN over the concatenated sequence.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.compat import v1
+
+from tests.op_test import run_op
+
+rng = np.random.RandomState(7)
+
+
+def _nested_batch(b=3, s=4, t=5, d=2, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(b, s, t, d).astype(np.float32)
+    Length = np.array([4, 2, 3][:b], np.int32)
+    SubLength = r.randint(1, t + 1, (b, s)).astype(np.int32)
+    SubLength *= (np.arange(s)[None, :] < Length[:, None])
+    return X, Length, SubLength
+
+
+# ------------------------------------------------------------------- ops
+def test_nested_sequence_pool_matches_loops():
+    X, L, SL = _nested_batch()
+    for pt_ in ("SUM", "AVERAGE", "MAX", "LAST", "FIRST", "SQRT"):
+        got = run_op("nested_sequence_pool",
+                     {"X": X, "Length": L, "SubLength": SL},
+                     attrs={"pooltype": pt_})["Out"]
+        b, s = X.shape[:2]
+        exp = np.zeros((b, s, X.shape[-1]), np.float32)
+        for i in range(b):
+            for j in range(L[i]):
+                seg = X[i, j, :SL[i, j]]
+                if seg.size == 0:
+                    continue
+                if pt_ == "SUM":
+                    exp[i, j] = seg.sum(0)
+                elif pt_ == "AVERAGE":
+                    exp[i, j] = seg.mean(0)
+                elif pt_ == "SQRT":
+                    exp[i, j] = seg.sum(0) / np.sqrt(len(seg))
+                elif pt_ == "MAX":
+                    exp[i, j] = seg.max(0)
+                elif pt_ == "LAST":
+                    exp[i, j] = seg[-1]
+                elif pt_ == "FIRST":
+                    exp[i, j] = seg[0]
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6,
+                                   err_msg=pt_)
+
+
+def test_nested_sequence_expand_and_slice():
+    X, L, SL = _nested_batch()
+    b, s, t, d = X.shape
+    vals = rng.randn(b, s, d).astype(np.float32)
+    got = run_op("nested_sequence_expand",
+                 {"X": vals, "Y": X, "Length": L, "SubLength": SL})["Out"]
+    assert got.shape == (b, s, t, d)
+    for i in range(b):
+        for j in range(s):
+            n = SL[i, j] if j < L[i] else 0
+            np.testing.assert_allclose(
+                got[i, j, :n], np.tile(vals[i, j], (n, 1)), rtol=1e-6)
+            np.testing.assert_allclose(got[i, j, n:], 0.0)
+
+    off = np.array([1, 0, 1], np.int32)
+    size = np.array([2, 1, 1], np.int32)
+    sl = run_op("nested_sequence_slice",
+                {"X": X, "Offset": off, "Size": size,
+                 "Length": L, "SubLength": SL})
+    for i in range(b):
+        for j in range(size[i]):
+            np.testing.assert_allclose(sl["Out"][i, j], X[i, off[i] + j])
+            assert sl["OutSubLength"][i, j] == SL[i, off[i] + j]
+        assert sl["OutLength"][i] == size[i]
+        np.testing.assert_allclose(sl["Out"][i, size[i]:], 0.0)
+
+    # out-of-table request: fewer sub-seqs come back, never a silently
+    # duplicated clamp
+    oob = run_op("nested_sequence_slice",
+                 {"X": X, "Offset": np.array([3, 0, 0], np.int32),
+                  "Size": np.array([3, 1, 1], np.int32),
+                  "Length": L, "SubLength": SL})
+    assert oob["OutLength"][0] == 1  # only sub-seq 3 exists past offset 3
+    np.testing.assert_allclose(oob["Out"][0, 0], X[0, 3])
+    np.testing.assert_allclose(oob["Out"][0, 1:], 0.0)
+
+
+def test_sub_nested_seq_selects_sentences():
+    X, L, SL = _nested_batch()
+    idx = np.array([[2, 0], [1, -1], [0, 2]], np.int32)
+    got = run_op("sub_nested_seq",
+                 {"X": X, "Indices": idx, "Length": L, "SubLength": SL})
+    for i in range(X.shape[0]):
+        for k in range(idx.shape[1]):
+            if idx[i, k] < 0:
+                np.testing.assert_allclose(got["Out"][i, k], 0.0)
+                assert got["OutSubLength"][i, k] == 0
+            else:
+                np.testing.assert_allclose(got["Out"][i, k], X[i, idx[i, k]])
+                assert got["OutSubLength"][i, k] == SL[i, idx[i, k]]
+    np.testing.assert_array_equal(got["OutLength"], [2, 1, 2])
+
+
+def test_nested_rnn_equals_flat_gru_over_concatenation():
+    """The reference nested-RNN equivalence (sequence_nest_rnn.conf spec):
+    outer memory boots each sub-sequence's inner RNN, so the nested run
+    over a split sequence == flat GRU over the concatenation."""
+    b, s, t, d = 2, 3, 4, 5
+    r = np.random.RandomState(1)
+    W = r.randn(d, 3 * d).astype(np.float32) * 0.3
+    Bias = r.randn(1, 3 * d).astype(np.float32) * 0.1
+    SL = np.array([[4, 2, 3], [3, 4, 0]], np.int32)
+    L = np.array([3, 2], np.int32)
+    X = r.randn(b, s, t, 3 * d).astype(np.float32) * 0.5
+
+    out = run_op("nested_rnn",
+                 {"Input": X, "Weight": W, "Bias": Bias,
+                  "Length": L, "SubLength": SL})
+
+    # flat reference: concatenate each sample's valid items, run the gru
+    # op over the packed sequence, compare the final + per-boundary states
+    flat_len = np.array([int(SL[i, :L[i]].sum()) for i in range(b)],
+                        np.int32)
+    T = int(flat_len.max())
+    flat = np.zeros((b, T, 3 * d), np.float32)
+    for i in range(b):
+        pos = 0
+        for j in range(L[i]):
+            n = SL[i, j]
+            flat[i, pos:pos + n] = X[i, j, :n]
+            pos += n
+    ref = run_op("gru", {"Input": flat, "Weight": W, "Bias": Bias,
+                         "Length": flat_len})["Hidden"]
+    for i in range(b):
+        pos = 0
+        for j in range(L[i]):
+            n = SL[i, j]
+            if n == 0:
+                continue
+            pos += n
+            np.testing.assert_allclose(
+                out["OuterHidden"][i, j], ref[i, pos - 1],
+                rtol=1e-4, atol=1e-5,
+                err_msg=f"sample {i} boundary {j}")
+
+
+# ----------------------------------------------------- feeder + layer DSL
+def test_data_feeder_nested():
+    var = layers.data("para", shape=[3], dtype="float32", lod_level=2)
+    feeder = pt.DataFeeder([var], pad_multiple=2)
+    sample0 = [np.ones((2, 3)), np.full((3, 3), 2.0)]
+    sample1 = [np.full((1, 3), 5.0)]
+    feed = feeder.feed([(sample0,), (sample1,)])
+    X = feed["para"]
+    assert X.shape[0] == 2 and X.ndim == 4 and X.shape[-1] == 3
+    np.testing.assert_array_equal(feed["para@LENGTH"], [2, 1])
+    np.testing.assert_array_equal(feed["para@SUBLENGTH"][0, :2], [2, 3])
+    np.testing.assert_array_equal(feed["para@SUBLENGTH"][1, :1], [1])
+    np.testing.assert_allclose(X[0, 1, :3], 2.0)
+    np.testing.assert_allclose(X[1, 0, :1], 5.0)
+    np.testing.assert_allclose(X[1, 1], 0.0)
+
+    # feature-only declaration must NOT cap sub-seq count at the feature
+    # dim: a 5-sub-seq sample through shape=[3] keeps all 5
+    many = [np.full((1, 3), float(i)) for i in range(5)]
+    feed5 = pt.DataFeeder([var], pad_multiple=1).feed([(many,)])
+    np.testing.assert_array_equal(feed5["para@LENGTH"], [5])
+    assert feed5["para"].shape[1] == 5
+
+    # declared static dims wider than the batch: data, @LENGTH and
+    # @SUBLENGTH must still agree on [b, s, t]
+    wide = layers.data("wide", shape=[8, 10, 3], dtype="float32",
+                      lod_level=2)
+    feed2 = pt.DataFeeder([wide], pad_multiple=2).feed(
+        [(sample0,), (sample1,)])
+    assert feed2["wide"].shape == (2, 8, 10, 3)
+    assert feed2["wide@SUBLENGTH"].shape == (2, 8)
+    from tests.op_test import run_op as _run
+    pooled = _run("nested_sequence_pool",
+                  {"X": feed2["wide"], "Length": feed2["wide@LENGTH"],
+                   "SubLength": feed2["wide@SUBLENGTH"]},
+                  attrs={"pooltype": "SUM"})["Out"]
+    assert pooled.shape == (2, 8, 3)
+
+
+def test_nested_layers_end_to_end_training():
+    """Paragraph classifier: nested tokens -> fc to gates -> nested_rnn
+    -> last outer state -> logits; trains (loss falls) under the
+    Executor with DataFeeder-produced nested feeds."""
+    d, vocab_d, h = 4, 4, 6
+    para = layers.data("para", shape=[3, 5, vocab_d], dtype="float32",
+                       lod_level=2, append_batch_size=True)
+    label = layers.data("label", shape=[1], dtype="int64")
+    gates = layers.fc(para, 3 * h, num_flatten_dims=3, bias_attr=False)
+    layers.link_sequence(gates, para)
+    gates.lod_level = 2
+    gates.block.vars[gates.name + "@SUBLENGTH"] = para.sub_length_var()
+    hidden, outer = layers.nested_rnn(gates, h)
+    last = layers.sequence_pool(outer, "last")
+    logits = layers.fc(last, 2)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    r = np.random.RandomState(0)
+    X = r.randn(4, 3, 5, vocab_d).astype(np.float32)
+    L = np.array([3, 2, 1, 3], np.int32)
+    SL = r.randint(1, 6, (4, 3)).astype(np.int32)
+    SL *= (np.arange(3)[None] < L[:, None])
+    y = r.randint(0, 2, (4, 1)).astype(np.int64)
+    feed = {"para": X, "para@LENGTH": L, "para@SUBLENGTH": SL, "label": y}
+    losses = [float(np.asarray(exe.run(feed=feed,
+                                       fetch_list=[loss])[0]).ravel()[0])
+              for _ in range(15)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+# ------------------------------------------------------------- v1 compat
+def test_v1_nested_recurrent_group_matches_flat():
+    """The reference nested-RNN book test (sequence_nest_rnn.conf):
+    an outer recurrent_group over SubsequenceInput whose inner group
+    boots from the outer memory must equal the flat recurrent_group
+    over the concatenated sequence."""
+    b, s, t, d = 2, 3, 4, 5
+    r = np.random.RandomState(3)
+    X = r.randn(b, s, t, d).astype(np.float32) * 0.5
+    SL = np.array([[4, 2, 3], [3, 4, 0]], np.int32)
+    L = np.array([3, 2], np.int32)
+
+    def build_nested():
+        para = layers.data("para", shape=[s, t, d], dtype="float32",
+                           lod_level=2)
+
+        def outer_step(sent):
+            omem = v1.memory(name="outer", size=d)
+
+            def inner_step(x_t):
+                imem = v1.memory(name="inner", size=d, boot_layer=omem)
+                nxt = v1.mixed_layer(
+                    size=d,
+                    input=[v1.full_matrix_projection(
+                               x_t, size=d,
+                               param_attr=pt.ParamAttr(name="w_in")),
+                           v1.full_matrix_projection(
+                               imem, size=d,
+                               param_attr=pt.ParamAttr(name="w_rec"))],
+                    act=v1.TanhActivation(), bias_attr=False,
+                    name="inner")
+                return nxt
+
+            inner_out = v1.recurrent_group(inner_step, sent)
+            lastv = v1.last_seq(inner_out)
+            _ = v1.mixed_layer(size=d,
+                               input=[v1.identity_projection(lastv)],
+                               bias_attr=False, name="outer")
+            return lastv
+
+        out = v1.recurrent_group(outer_step, v1.SubsequenceInput(para))
+        return v1.last_seq(out)
+
+    def build_flat(T):
+        seq = layers.data("seq", shape=[T, d], dtype="float32",
+                          lod_level=1)
+
+        def step(x_t):
+            mem = v1.memory(name="m", size=d)
+            nxt = v1.mixed_layer(
+                size=d,
+                input=[v1.full_matrix_projection(
+                           x_t, size=d,
+                           param_attr=pt.ParamAttr(name="w_in")),
+                       v1.full_matrix_projection(
+                           mem, size=d,
+                           param_attr=pt.ParamAttr(name="w_rec"))],
+                act=v1.TanhActivation(), bias_attr=False, name="m")
+            return nxt
+
+        out = v1.recurrent_group(step, seq)
+        return v1.last_seq(out)
+
+    # shared weights: fix the RNG so both programs initialize identically
+    flat_len = np.array([int(SL[i, :L[i]].sum()) for i in range(b)],
+                        np.int32)
+    T = int(flat_len.max())
+    flat = np.zeros((b, T, d), np.float32)
+    for i in range(b):
+        pos = 0
+        for j in range(L[i]):
+            n = SL[i, j]
+            flat[i, pos:pos + n] = X[i, j, :n]
+            pos += n
+
+    def run(build, feed, seed):
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = seed
+        with pt.program_guard(main, startup):
+            fetch = build()
+        scope = pt.Scope()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope)
+        (out,) = exe.run(main, feed=feed, fetch_list=[fetch], scope=scope)
+        return np.asarray(out), scope
+
+    got, scope_n = run(lambda: build_nested(),
+                       {"para": X, "para@LENGTH": L, "para@SUBLENGTH": SL},
+                       seed=11)
+    ref, scope_f = run(lambda: build_flat(T),
+                       {"seq": flat, "seq@LENGTH": flat_len}, seed=11)
+    # identical seeds -> identical [d,d] weights in both programs
+    np.testing.assert_allclose(
+        np.asarray(scope_n.get("w_in")), np.asarray(scope_f.get("w_in")))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_v1_sub_nested_seq_layer():
+    X, L, SL = _nested_batch()
+
+    def build():
+        para = layers.data("para", shape=list(X.shape[1:]),
+                           dtype="float32", lod_level=2)
+        idx = layers.data("idx", shape=[2], dtype="int64")
+        sel = v1.sub_nested_seq_layer(para, idx)
+        return layers.nested_sequence_pool(sel, "sum")
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        fetch = build()
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    idx = np.array([[1, 0], [0, -1], [2, 1]], np.int64)
+    (out,) = exe.run(
+        main,
+        feed={"para": X, "para@LENGTH": L, "para@SUBLENGTH": SL,
+              "idx": idx},
+        fetch_list=[fetch], scope=scope)
+    out = np.asarray(out)
+    for i in range(X.shape[0]):
+        for k in range(2):
+            if idx[i, k] < 0:
+                np.testing.assert_allclose(out[i, k], 0.0)
+            else:
+                np.testing.assert_allclose(
+                    out[i, k], X[i, idx[i, k], :SL[i, idx[i, k]]].sum(0),
+                    rtol=1e-5, atol=1e-5)
+
+
+def test_sub_nested_seq_bounds_checks():
+    """Indices past the sample's real sub-seq count are padding, never
+    an out-of-bounds read (was NaN data + overflowed sub-length)."""
+    X, L, SL = _nested_batch()
+    idx = np.array([[7, 0], [1, 5], [0, 99]], np.int32)
+    got = run_op("sub_nested_seq",
+                 {"X": X, "Indices": idx, "Length": L, "SubLength": SL})
+    assert np.isfinite(got["Out"]).all()
+    np.testing.assert_allclose(got["Out"][0, 0], 0.0)   # 7 >= L[0]=4
+    np.testing.assert_allclose(got["Out"][2, 1], 0.0)   # 99 out of range
+    assert got["OutSubLength"][0, 0] == 0
+    assert got["OutSubLength"][2, 1] == 0
+    np.testing.assert_array_equal(got["OutLength"], [1, 1, 1])
