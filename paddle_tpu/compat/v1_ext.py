@@ -145,10 +145,20 @@ class StaticInput:
 
 
 class SubsequenceInput:
+    """Nested (2-level LoD) sequence input to recurrent_group: the outer
+    group iterates SUB-sequences — each step sees a 1-level padded
+    sequence [b, t, d] whose ``@LENGTH`` is that sub-sequence's lengths
+    (reference recurrent_group over subSequenceStartPositions,
+    ``RecurrentGradientMachine`` nested expansion; nested configs
+    ``gserver/tests/sequence_nest_rnn.conf``)."""
+
     def __init__(self, input):
-        raise NotImplementedError(
-            "nested (2-level LoD) sequence scanning is not carried; flatten "
-            "to one level or use layers.StaticRNN over padded [b,t,d]")
+        if getattr(input, "lod_level", 0) < 2:
+            raise ValueError(
+                "SubsequenceInput needs a nested (lod_level=2) sequence "
+                "variable [b, s, t, ...]; declare it with "
+                "layers.data(..., lod_level=2)")
+        self.input = input
 
 
 class BaseGeneratedInput:
@@ -156,13 +166,19 @@ class BaseGeneratedInput:
 
 
 class GeneratedInput(BaseGeneratedInput):
+    """Generation-mode input to ``beam_search``: at each decode step the
+    step function receives the EMBEDDING of the token each beam selected
+    last step (reference ``trainer_config_helpers`` GeneratedInput +
+    ``RecurrentGradientMachine.h:307-309`` generateSequence/beamSearch).
+    ``embedding_name`` shares the trained token-embedding parameter."""
+
     def __init__(self, size, embedding_name=None, embedding_size=None,
                  **_):
-        raise NotImplementedError(
-            "v1 generation (GeneratedInput + beam_search over "
-            "recurrent_group) is carried by the native path: "
-            "models.transformer.generate / layers.beam_search + "
-            "layers.beam_search_decode (see tests/test_transformer.py)")
+        if not embedding_size:
+            raise ValueError("GeneratedInput needs embedding_size")
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
 
 
 class BeamInput:
@@ -367,6 +383,19 @@ def memory(name=None, size=None, boot_layer=None, is_seq=False, **_):
     ctx = _RNN_STACK[-1]
     if boot_layer is not None:
         init = boot_layer
+        k = getattr(ctx, "beam_k", None)
+        if k:
+            # generation mode: the decode loop runs at the flattened
+            # [b*k] beam batch, so a boot from encoder state [b, ...]
+            # must expand to the beams like StaticInput contexts do
+            ex = ctx.parent_block.create_var(
+                name=unique_name.generate("beam_boot"),
+                dtype=init.dtype,
+                shape=[init.shape[0]] + list(init.shape[1:]))
+            ctx.parent_block.append_op(
+                type="beam_expand", inputs={"X": [init.name]},
+                outputs={"Out": [ex.name]}, attrs={"beam_size": k})
+            init = ex
     else:
         # zeros [batch, size] built in the PARENT block (the sub-block
         # cannot initialize its own carry)
@@ -398,7 +427,9 @@ def recurrent_group(step, input, reverse=False, name=None, **_):
     rnn = cf.StaticRNN(name=name)
     prog = rnn.helper.main_program
     parent = prog.current_block()
-    ctx = _V1RnnCtx(rnn, parent, seq_ins[0])
+    first = seq_ins[0].input if isinstance(seq_ins[0], SubsequenceInput) \
+        else seq_ins[0]
+    ctx = _V1RnnCtx(rnn, parent, first)
     _RNN_STACK.append(ctx)
     try:
         with rnn.step():
@@ -406,8 +437,21 @@ def recurrent_group(step, input, reverse=False, name=None, **_):
             for i in ins:
                 if isinstance(i, StaticInput):
                     step_args.append(i.input)  # closure env: unsliced
+                elif isinstance(i, SubsequenceInput):
+                    # outer iteration over SUB-sequences: the slice
+                    # [b, t, d] is itself a 1-level sequence whose
+                    # lengths are this step's slice of @SUBLENGTH
+                    x = i.input
+                    inner = rnn.step_input(x)
+                    inner_len = rnn.step_input(x.sub_length_var())
+                    inner.lod_level = 1
+                    rnn._sub.vars[inner.name + "@LENGTH"] = inner_len
+                    step_args.append(inner)
                 else:
                     step_args.append(rnn.step_input(i))
+            # LoD semantics: padded steps don't advance memories
+            if getattr(first, "lod_level", 0) > 0:
+                rnn.set_sequence_length(first.length_var())
             outs = step(*step_args)
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
             for mem, mname in ctx.mems:
@@ -428,7 +472,16 @@ def recurrent_group(step, input, reverse=False, name=None, **_):
             "reverse recurrent_group: use layers.dynamic_lstm/gru "
             "(is_reverse=True) or reverse the sequence with "
             "layers.sequence ops before/after the group")
-    return rnn()
+    result = rnn()
+    if getattr(first, "lod_level", 0) > 0:
+        # outputs are sequences over the scanned input's lengths (outer
+        # lengths for a nested group), so last_seq & friends index the
+        # true last step, not the padded one
+        out_len = first.length_var()
+        for o in (result if isinstance(result, list) else [result]):
+            o.lod_level = 1
+            o.block.vars[o.name + "@LENGTH"] = out_len
+    return result
 
 
 def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
@@ -496,14 +549,194 @@ def get_output_layer(input, arg_name, **_):
 
 
 def beam_search(step, input, bos_id, eos_id, beam_size, max_length=100,
-                **_):
-    raise NotImplementedError(
-        "v1 beam_search over recurrent_group is carried by the native "
-        "generation path: layers.beam_search + layers.beam_search_decode "
-        "per step inside layers.StaticRNN, or "
-        "models.transformer.generate (KV-cache decoding); see "
-        "tests/test_transformer.py and tests/test_book.py machine "
-        "translation")
+                name=None, **_):
+    """The v1 GENERATION DRIVER: beam search over a recurrent step
+    function (reference ``RecurrentGradientMachine.h:307-309``
+    generateSequence/beamSearch — per-token dynamic net expansion with
+    beam maintenance, exposed via ``api/SequenceGenerator.cpp``).
+
+    TPU-native lowering: a fixed-length ``StaticRNN`` decode loop whose
+    carried state is (current beam tokens [b, k], accumulated scores
+    [b, k], the user step's memories).  Each tick embeds the beams' last
+    tokens (the ``GeneratedInput`` contract), runs the user step on the
+    flattened [b*k] batch (StaticInput contexts pre-expanded to beams),
+    expands/selects with the fixed-width masked ``beam_search`` op, and
+    REORDERS every user memory by the selected parents (``beam_gather``)
+    — the decoder-state shuffling the reference performs on its
+    dynamically expanded nets.  Parent pointers are stacked per step and
+    backtracked once at the end (``beam_search_decode``).
+
+    Returns the decoded token variable [b, beam_size, max_length]
+    (everything after each hypothesis's first ``eos_id`` is padded with
+    ``eos_id``); its ``_v1_outputs['scores']`` carries the final [b, k]
+    log-prob scores (``get_output_layer``-accessible)."""
+    from ..layers import control_flow as cf
+
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    gens = [i for i in ins if isinstance(i, BaseGeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    g = gens[0]
+    statics = [i for i in ins if isinstance(i, StaticInput)]
+    if not statics:
+        raise ValueError(
+            "beam_search needs at least one StaticInput context (the "
+            "encoded source) to size the decode batch")
+    ref = statics[0].input
+    k = int(beam_size)
+
+    helper = LayerHelper("v1_beam_search", name=name)
+    block = helper.main_program.current_block()
+
+    emb_attr = (ParamAttr(name=g.embedding_name)
+                if g.embedding_name else None)
+    emb_w = helper.create_parameter(
+        emb_attr, shape=[g.size, g.embedding_size], dtype="float32",
+        suffix=None if g.embedding_name else "emb_w")
+
+    # static contexts expand to the beam layout [b*k, ...] — INCLUDING
+    # their sequence metadata, so masked sequence ops inside the step
+    # (simple_attention etc.) still see lengths for ragged encoders
+    expanded = {}
+    for s in statics:
+        ex = helper.create_tmp_variable(
+            s.input.dtype, [s.input.shape[0]] + list(s.input.shape[1:]))
+        helper.append_op(
+            type="beam_expand", inputs={"X": [s.input.name]},
+            outputs={"Out": [ex.name]}, attrs={"beam_size": k})
+        if getattr(s.input, "lod_level", 0) > 0:
+            exl = helper.create_tmp_variable(
+                "int32", [s.input.shape[0]], stop_gradient=True)
+            helper.append_op(
+                type="beam_expand",
+                inputs={"X": [s.input.length_var().name]},
+                outputs={"Out": [exl.name]}, attrs={"beam_size": k})
+            ex.lod_level = s.input.lod_level
+            ex.block.vars[ex.name + "@LENGTH"] = exl
+        expanded[id(s)] = ex
+
+    ids0 = helper.create_tmp_variable("int32", [ref.shape[0], k],
+                                      stop_gradient=True)
+    scores0 = helper.create_tmp_variable("float32", [ref.shape[0], k],
+                                         stop_gradient=True)
+    helper.append_op(
+        type="beam_init", inputs={"Ref": [ref.name]},
+        outputs={"Ids": [ids0.name], "Scores": [scores0.name]},
+        attrs={"beam_size": k, "bos_id": int(bos_id)})
+    # dummy scanned input drives the fixed-length loop
+    ticks = helper.create_tmp_variable("float32",
+                                       [ref.shape[0], int(max_length)],
+                                       stop_gradient=True)
+    helper.append_op(
+        type="fill_constant_batch_size_like", inputs={"Input": [ref.name]},
+        outputs={"Out": [ticks.name]},
+        attrs={"shape": (1, int(max_length)), "dtype": "float32",
+               "value": 0.0, "input_dim_idx": 0, "output_dim_idx": 0})
+
+    rnn = cf.StaticRNN(name=name)
+    ctx = _V1RnnCtx(rnn, block, expanded[id(statics[0])])
+    ctx.beam_k = k  # memory(boot_layer=...) must expand boots to beams
+    _RNN_STACK.append(ctx)
+    try:
+        with rnn.step():
+            rnn.step_input(ticks)
+            cur_ids = rnn.memory(ids0)
+            cur_scores = rnn.memory(scores0)
+            sub = rnn._sub
+            flat_ids = _tensor.reshape(cur_ids, [-1, 1])
+            emb = sub.create_var(
+                name=unique_name.generate("beam_emb"), dtype="float32",
+                shape=[None, g.embedding_size])
+            sub.append_op(
+                type="lookup_table",
+                inputs={"W": [emb_w.name], "Ids": [flat_ids.name]},
+                outputs={"Out": [emb.name]}, attrs={"padding_idx": -1})
+            emb.shape = (flat_ids.shape[0], g.embedding_size)
+
+            step_args = []
+            for i in ins:
+                if isinstance(i, BaseGeneratedInput):
+                    step_args.append(emb)
+                elif isinstance(i, StaticInput):
+                    step_args.append(expanded[id(i)])
+                else:
+                    raise ValueError(
+                        "beam_search inputs must be GeneratedInput or "
+                        "StaticInput")
+            probs = step(*step_args)
+            probs = probs if not isinstance(probs, (list, tuple)) \
+                else probs[0]
+            logp = layers.log(probs)
+            logp3 = _tensor.reshape(logp, [-1, k, int(g.size)])
+            sel_ids = sub.create_var(
+                name=unique_name.generate("beam_ids"), dtype="int32",
+                shape=list(cur_ids.shape))
+            sel_scores = sub.create_var(
+                name=unique_name.generate("beam_scores"), dtype="float32",
+                shape=list(cur_scores.shape))
+            parent = sub.create_var(
+                name=unique_name.generate("beam_parent"), dtype="int32",
+                shape=list(cur_ids.shape))
+            sub.append_op(
+                type="beam_search",
+                inputs={"PreIds": [cur_ids.name],
+                        "PreScores": [cur_scores.name],
+                        "Scores": [logp3.name]},
+                outputs={"SelectedIds": [sel_ids.name],
+                         "SelectedScores": [sel_scores.name],
+                         "ParentIdx": [parent.name]},
+                attrs={"beam_size": k, "end_id": int(eos_id)})
+            # user memories follow their selected parent beams; unlike
+            # recurrent_group there is NO single-memory fallback — the
+            # step's return value is the token distribution, never a
+            # state, so an unnamed memory is always a config error
+            for mem, mname in ctx.mems:
+                target = ctx.named.get(mname)
+                if target is None:
+                    raise ValueError(
+                        f"memory(name={mname!r}) inside beam_search has "
+                        f"no same-named step layer; name the layer that "
+                        f"produces the memory's next value")
+                moved = sub.create_var(
+                    name=unique_name.generate("beam_mem"),
+                    dtype=target.dtype, shape=list(target.shape))
+                sub.append_op(
+                    type="beam_gather",
+                    inputs={"X": [target.name], "Parent": [parent.name]},
+                    outputs={"Out": [moved.name]})
+                rnn.update_memory(mem, moved)
+            rnn.update_memory(cur_ids, sel_ids)
+            rnn.update_memory(cur_scores, sel_scores)
+            rnn.step_output(sel_ids)
+            rnn.step_output(parent)
+            rnn.step_output(sel_scores)
+    finally:
+        _RNN_STACK.pop()
+    ids_s, parent_s, scores_s = rnn()   # each [b, T, k]
+
+    def _tbk(x):
+        out = helper.create_tmp_variable(x.dtype, [x.shape[1], x.shape[0],
+                                                   x.shape[2]])
+        helper.append_op(type="transpose", inputs={"X": [x.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"axis": (1, 0, 2)})
+        return out
+
+    sent = helper.create_tmp_variable(
+        "int32", [ref.shape[0], k, int(max_length)], stop_gradient=True)
+    sent_scores = helper.create_tmp_variable(
+        "float32", [ref.shape[0], k], stop_gradient=True)
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [_tbk(ids_s).name],
+                "ParentIdx": [_tbk(parent_s).name],
+                "Scores": [_tbk(scores_s).name]},
+        outputs={"SentenceIds": [sent.name],
+                 "SentenceScores": [sent_scores.name]},
+        attrs={"end_id": int(eos_id)})
+    sent._v1_outputs = {"scores": sent_scores}
+    _register_name(sent, name)
+    return sent
 
 
 def eos_layer(input, eos_id, name=None, **_):
@@ -947,10 +1180,13 @@ def huber_classification_cost(input, label, **_):
     return layers.mean(out)
 
 
-def sub_nested_seq_layer(input, selected_indices, **_):
-    raise NotImplementedError(
-        "nested (2-level LoD) sequences are not carried — the padded-dense "
-        "convention is one level; restructure as [b, t, d] + @LENGTH")
+def sub_nested_seq_layer(input, selected_indices, name=None, **_):
+    """Select sub-sequences of a nested input by per-sample indices
+    (reference SubNestedSequenceLayer.cpp) — lowers to the native
+    sub_nested_seq op."""
+    out = layers.sub_nested_seq(input, selected_indices)
+    _register_name(out, name)
+    return out
 
 
 def cross_entropy_over_beam(input, **_):

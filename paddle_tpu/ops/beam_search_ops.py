@@ -75,3 +75,36 @@ def beam_search_decode(Ids, ParentIdx, Scores=None, end_id=1, **_):
     if Scores is not None:
         out["SentenceScores"] = Scores[-1] if Scores.ndim == 3 else Scores
     return out
+
+
+@register_op("beam_init", nondiff=True)
+def beam_init(Ref, beam_size=4, bos_id=0, **_):
+    """Initial beam state for a [b]-batched decode (batch taken from
+    Ref's leading dim): Ids [b, k] = bos, Scores [b, k] = [0, -inf...]
+    so the first expansion draws k distinct tokens from beam 0 only —
+    the reference RecurrentGradientMachine's generation bootstrap
+    (RecurrentGradientMachine.h:307 generateSequence)."""
+    b = Ref.shape[0]
+    k = int(beam_size)
+    ids = jnp.full((b, k), int(bos_id), jnp.int32)
+    scores = jnp.full((b, k), -1e38, jnp.float32).at[:, 0].set(0.0)
+    return {"Ids": ids, "Scores": scores}
+
+
+@register_op("beam_expand", nondiff=True)
+def beam_expand(X, beam_size=4, **_):
+    """Tile each sample's row beam_size times along axis 0:
+    [b, ...] -> [b*k, ...] (the static-input expansion the reference
+    performs when entering generation mode)."""
+    return {"Out": jnp.repeat(X, int(beam_size), axis=0)}
+
+
+@register_op("beam_gather", nondiff=True)
+def beam_gather(X, Parent, **_):
+    """Reorder per-beam state rows by the beam parents selected this
+    step: X [b*k, ...], Parent [b, k] -> rows of X gathered so row
+    (i*k + j) becomes X[i*k + Parent[i, j]] — the decoder-state
+    shuffling the reference does when beams switch parents."""
+    b, k = Parent.shape
+    flat = (jnp.arange(b)[:, None] * k + Parent.astype(jnp.int32)).reshape(-1)
+    return {"Out": jnp.take(X, flat, axis=0)}
