@@ -35,22 +35,41 @@ int main(int argc, char** argv) {
   float* data = (float*)malloc(sizeof(float) * numel);
   for (int64_t i = 0; i < numel; i++) data[i] = (float)atof(argv[6 + i]);
 
+  /* introspection: enumerate the model's feed/fetch surface */
+  for (int32_t i = 0; i < pt_engine_num_inputs(h); i++) {
+    const int64_t* ishape;
+    int32_t irank;
+    pt_engine_input_shape(h, i, &ishape, &irank);
+    fprintf(stderr, "input %d: %s rank=%d first_dim=%lld\n", i,
+            pt_engine_input_name(h, i), irank,
+            irank ? (long long)ishape[0] : -1);
+  }
+  for (int32_t i = 0; i < pt_engine_num_outputs(h); i++) {
+    fprintf(stderr, "output %d: %s\n", i, pt_engine_output_name(h, i));
+  }
+
   const char* names[1] = {argv[3]};
   const float* datas[1] = {data};
   const int64_t* shapes[1] = {shape};
   int32_t ranks[1] = {2};
 
-  const float* out;
-  const int64_t* out_shape;
-  int32_t out_rank;
-  if (pt_engine_run(h, names, datas, shapes, ranks, 1, 0, &out, &out_shape,
-                    &out_rank) != 0) {
+  if (pt_engine_run_all(h, names, datas, shapes, ranks, 1) != 0) {
     fprintf(stderr, "run failed: %s\n", pt_last_error());
     return 1;
   }
-  int64_t n = 1;
-  for (int32_t d = 0; d < out_rank; d++) n *= out_shape[d];
-  for (int64_t i = 0; i < n; i++) printf("%f\n", out[i]);
+  /* every fetch target, tagged by index */
+  for (int32_t oi = 0; oi < pt_engine_num_outputs(h); oi++) {
+    const float* out;
+    const int64_t* out_shape;
+    int32_t out_rank;
+    if (pt_engine_output(h, oi, &out, &out_shape, &out_rank) != 0) {
+      fprintf(stderr, "output %d failed: %s\n", oi, pt_last_error());
+      return 1;
+    }
+    int64_t n = 1;
+    for (int32_t d = 0; d < out_rank; d++) n *= out_shape[d];
+    for (int64_t i = 0; i < n; i++) printf("%d %f\n", oi, out[i]);
+  }
 
   pt_engine_destroy(h);
   pt_shutdown();

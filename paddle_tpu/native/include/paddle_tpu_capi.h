@@ -32,13 +32,33 @@ const char* pt_last_error(void);
 /* Load an exported inference model directory; NULL on failure. */
 void* pt_engine_create(const char* model_dir);
 
-/* Run one forward pass.
+/* ---- model introspection (reference capi/gradient_machine.h +
+ * capi/matrix.h ergonomics): enumerate the exported program's feed and
+ * fetch surface.  Returned strings/arrays are owned by the handle. ---- */
+int32_t pt_engine_num_inputs(void* handle);
+const char* pt_engine_input_name(void* handle, int32_t i);
+/* Declared input shape; -1 marks a dynamic (batch) dimension. */
+int pt_engine_input_shape(void* handle, int32_t i, const int64_t** shape,
+                          int32_t* rank);
+int32_t pt_engine_num_outputs(void* handle);
+const char* pt_engine_output_name(void* handle, int32_t i);
+
+/* Run one forward pass, computing and caching EVERY fetch target.
  *   names[i]   feed variable name
  *   datas[i]   float32 buffer, row-major
  *   shapes[i]  dimensions, ranks[i] entries
- *   out_index  which fetch target to return
- * Output pointers are owned by the handle and valid until the next
- * pt_engine_run/pt_engine_destroy.  Returns 0 on success. */
+ * Read results back per target with pt_engine_output.  Returns 0 on
+ * success. */
+int pt_engine_run_all(void* handle, const char** names, const float** datas,
+                      const int64_t** shapes, const int32_t* ranks,
+                      int32_t n_inputs);
+
+/* Read cached fetch target ``i`` of the last run.  Output pointers are
+ * owned by the handle and valid until the next run/destroy. */
+int pt_engine_output(void* handle, int32_t i, const float** out_data,
+                     const int64_t** out_shape, int32_t* out_rank);
+
+/* Back-compat single-output form: pt_engine_run_all + pt_engine_output. */
 int pt_engine_run(void* handle, const char** names, const float** datas,
                   const int64_t** shapes, const int32_t* ranks,
                   int32_t n_inputs, int32_t out_index,

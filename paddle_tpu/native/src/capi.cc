@@ -49,9 +49,104 @@ void capture_py_error() {
 
 struct Engine {
   PyObject* engine = nullptr;            // paddle_tpu.inference.InferenceEngine
-  std::vector<float> out_data;           // last fetched output copy
-  std::vector<int64_t> out_shape;
+  // introspection (filled at create): reference capi exposes the
+  // gradient machine's argument names/shapes (capi/gradient_machine.h,
+  // capi/matrix.h); here the exported program's feed/fetch surface
+  std::vector<std::string> input_names;
+  std::vector<std::vector<int64_t>> input_shapes;  // -1 = dynamic dim
+  std::vector<std::string> output_names;
+  // last run's result (ALL fetch targets); conversion to float buffers
+  // happens LAZILY per requested index so legacy single-output callers
+  // don't pay for targets they never read
+  PyObject* last_result = nullptr;
+  std::vector<bool> converted;
+  std::vector<std::vector<float>> out_data;
+  std::vector<std::vector<int64_t>> out_shape;
 };
+
+// Convert cached fetch target i (GIL must be held).  Returns false and
+// sets g_error on failure.
+bool convert_output(Engine* eng, int32_t i) {
+  if (eng->converted[i]) return true;
+  bool ok = false;
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* item = np ? PySequence_GetItem(eng->last_result, i) : nullptr;
+  PyObject* arr = item ? PyObject_CallMethod(np, "asarray", "Os", item,
+                                             "float32")
+                       : nullptr;
+  if (arr) {
+    PyObject* shape = PyObject_GetAttrString(arr, "shape");
+    PyObject* flat = PyObject_CallMethod(arr, "flatten", nullptr);
+    PyObject* lst =
+        flat ? PyObject_CallMethod(flat, "tolist", nullptr) : nullptr;
+    if (shape && lst) {
+      Py_ssize_t rank = PyTuple_Size(shape);
+      eng->out_shape[i].resize(rank);
+      for (Py_ssize_t d = 0; d < rank; d++) {
+        eng->out_shape[i][d] = PyLong_AsLongLong(PyTuple_GET_ITEM(shape, d));
+      }
+      Py_ssize_t numel = PyList_Size(lst);
+      eng->out_data[i].resize(numel);
+      for (Py_ssize_t j = 0; j < numel; j++) {
+        eng->out_data[i][j] =
+            static_cast<float>(PyFloat_AsDouble(PyList_GET_ITEM(lst, j)));
+      }
+      eng->converted[i] = true;
+      ok = true;
+    }
+    Py_XDECREF(lst);
+    Py_XDECREF(flat);
+    Py_XDECREF(shape);
+    Py_DECREF(arr);
+  }
+  Py_XDECREF(item);
+  Py_XDECREF(np);
+  if (!ok) capture_py_error();
+  return ok;
+}
+
+// Fill Engine::input_*/output_* from the python engine object.
+bool load_introspection(Engine* eng) {
+  PyObject* feed_vars = PyObject_GetAttrString(eng->engine, "feed_vars");
+  PyObject* fetch_vars = PyObject_GetAttrString(eng->engine, "fetch_vars");
+  bool ok = feed_vars && fetch_vars;
+  if (ok) {
+    Py_ssize_t n = PySequence_Size(feed_vars);
+    for (Py_ssize_t i = 0; ok && i < n; i++) {
+      PyObject* v = PySequence_GetItem(feed_vars, i);
+      PyObject* name = v ? PyObject_GetAttrString(v, "name") : nullptr;
+      PyObject* shape = v ? PyObject_GetAttrString(v, "shape") : nullptr;
+      if (name && shape) {
+        eng->input_names.emplace_back(PyUnicode_AsUTF8(name));
+        std::vector<int64_t> dims;
+        Py_ssize_t rank = PySequence_Size(shape);
+        for (Py_ssize_t d = 0; d < rank; d++) {
+          PyObject* e = PySequence_GetItem(shape, d);
+          dims.push_back(e ? PyLong_AsLongLong(e) : -1);
+          Py_XDECREF(e);
+        }
+        eng->input_shapes.push_back(std::move(dims));
+      } else {
+        ok = false;
+      }
+      Py_XDECREF(shape);
+      Py_XDECREF(name);
+      Py_XDECREF(v);
+    }
+    Py_ssize_t m = ok ? PySequence_Size(fetch_vars) : 0;
+    for (Py_ssize_t i = 0; ok && i < m; i++) {
+      PyObject* v = PySequence_GetItem(fetch_vars, i);
+      PyObject* name = v ? PyObject_GetAttrString(v, "name") : nullptr;
+      if (name) eng->output_names.emplace_back(PyUnicode_AsUTF8(name));
+      else ok = false;
+      Py_XDECREF(name);
+      Py_XDECREF(v);
+    }
+  }
+  Py_XDECREF(fetch_vars);
+  Py_XDECREF(feed_vars);
+  return ok;
+}
 
 bool g_we_initialized = false;
 PyThreadState* g_saved_tstate = nullptr;
@@ -118,6 +213,12 @@ void* pt_engine_create(const char* model_dir) {
   if (obj) {
     eng = new Engine();
     eng->engine = obj;
+    if (!load_introspection(eng)) {
+      capture_py_error();
+      Py_DECREF(obj);
+      delete eng;
+      eng = nullptr;
+    }
   }
   Py_XDECREF(cls);
   Py_DECREF(mod);
@@ -125,20 +226,80 @@ void* pt_engine_create(const char* model_dir) {
   return eng;
 }
 
-// Run inference.  names[i]: feed name; datas[i]: float32 buffer;
-// shapes[i]: dims (ranks[i] entries).  out_index selects the fetch target.
-// On success fills out pointers (owned by the handle) and returns 0.
-int pt_engine_run(void* handle, const char** names, const float** datas,
-                  const int64_t** shapes, const int32_t* ranks,
-                  int32_t n_inputs, int32_t out_index,
-                  const float** out_data, const int64_t** out_shape,
-                  int32_t* out_rank) {
+// ---- introspection (reference capi/gradient_machine.h + matrix.h) ----
+int32_t pt_engine_num_inputs(void* handle) {
+  return static_cast<int32_t>(
+      static_cast<Engine*>(handle)->input_names.size());
+}
+
+const char* pt_engine_input_name(void* handle, int32_t i) {
+  auto* eng = static_cast<Engine*>(handle);
+  if (i < 0 || i >= static_cast<int32_t>(eng->input_names.size()))
+    return nullptr;
+  return eng->input_names[i].c_str();
+}
+
+int pt_engine_input_shape(void* handle, int32_t i, const int64_t** shape,
+                          int32_t* rank) {
+  auto* eng = static_cast<Engine*>(handle);
+  if (i < 0 || i >= static_cast<int32_t>(eng->input_shapes.size()))
+    return -1;
+  *shape = eng->input_shapes[i].data();
+  *rank = static_cast<int32_t>(eng->input_shapes[i].size());
+  return 0;
+}
+
+int32_t pt_engine_num_outputs(void* handle) {
+  return static_cast<int32_t>(
+      static_cast<Engine*>(handle)->output_names.size());
+}
+
+const char* pt_engine_output_name(void* handle, int32_t i) {
+  auto* eng = static_cast<Engine*>(handle);
+  if (i < 0 || i >= static_cast<int32_t>(eng->output_names.size()))
+    return nullptr;
+  return eng->output_names[i].c_str();
+}
+
+// Read one cached output of the last pt_engine_run/pt_engine_run_all
+// (converted lazily on first read).
+int pt_engine_output(void* handle, int32_t i, const float** out_data,
+                     const int64_t** out_shape, int32_t* out_rank) {
+  auto* eng = static_cast<Engine*>(handle);
+  if (!eng->last_result ||
+      i < 0 || i >= static_cast<int32_t>(eng->out_data.size())) {
+    g_error = "output index out of range (run the engine first)";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  bool ok = convert_output(eng, i);
+  PyGILState_Release(gil);
+  if (!ok) return -1;
+  *out_data = eng->out_data[i].data();
+  *out_shape = eng->out_shape[i].data();
+  *out_rank = static_cast<int32_t>(eng->out_shape[i].size());
+  return 0;
+}
+
+// Run inference, caching EVERY fetch target (read them back with
+// pt_engine_output).  names[i]: feed name; datas[i]: float32 buffer;
+// shapes[i]: dims (ranks[i] entries).  Returns 0 on success.
+int pt_engine_run_all(void* handle, const char** names, const float** datas,
+                      const int64_t** shapes, const int32_t* ranks,
+                      int32_t n_inputs) {
   auto* eng = static_cast<Engine*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = -1;
   PyObject* np = nullptr;
   PyObject* feed = nullptr;
   PyObject* result = nullptr;
+  // invalidate the previous run's cache up front: a FAILED run must not
+  // leave pt_engine_output silently serving stale results
+  Py_XDECREF(eng->last_result);
+  eng->last_result = nullptr;
+  eng->converted.clear();
+  eng->out_data.clear();
+  eng->out_shape.clear();
   do {
     np = PyImport_ImportModule("numpy");
     if (!np) break;
@@ -172,38 +333,14 @@ int pt_engine_run(void* handle, const char** names, const float** datas,
     if (!feed_ok) break;
     result = PyObject_CallMethod(eng->engine, "run", "O", feed);
     if (!result) break;
-    PyObject* item = PySequence_GetItem(result, out_index);
-    if (!item) break;
-    // normalize to a flat float64 list + shape tuple via numpy
-    PyObject* arr = PyObject_CallMethod(np, "asarray", "Os", item, "float32");
-    Py_DECREF(item);
-    if (!arr) break;
-    PyObject* shape = PyObject_GetAttrString(arr, "shape");
-    PyObject* flat = PyObject_CallMethod(arr, "flatten", nullptr);
-    PyObject* lst =
-        flat ? PyObject_CallMethod(flat, "tolist", nullptr) : nullptr;
-    if (shape && lst) {
-      Py_ssize_t rank = PyTuple_Size(shape);
-      eng->out_shape.resize(rank);
-      for (Py_ssize_t d = 0; d < rank; d++) {
-        eng->out_shape[d] =
-            PyLong_AsLongLong(PyTuple_GET_ITEM(shape, d));
-      }
-      Py_ssize_t numel = PyList_Size(lst);
-      eng->out_data.resize(numel);
-      for (Py_ssize_t j = 0; j < numel; j++) {
-        eng->out_data[j] =
-            static_cast<float>(PyFloat_AsDouble(PyList_GET_ITEM(lst, j)));
-      }
-      *out_data = eng->out_data.data();
-      *out_shape = eng->out_shape.data();
-      *out_rank = static_cast<int32_t>(rank);
-      rc = 0;
-    }
-    Py_XDECREF(lst);
-    Py_XDECREF(flat);
-    Py_XDECREF(shape);
-    Py_DECREF(arr);
+    Py_ssize_t n_out = PySequence_Size(result);
+    if (n_out < 0) break;  // non-sequence run() result: clean rc=-1
+    eng->last_result = result;  // cache was invalidated at entry
+    result = nullptr;  // ownership moved to the handle
+    eng->converted.assign(n_out, false);
+    eng->out_data.assign(n_out, {});
+    eng->out_shape.assign(n_out, {});
+    rc = 0;
   } while (false);
   if (rc != 0) capture_py_error();
   Py_XDECREF(result);
@@ -213,10 +350,22 @@ int pt_engine_run(void* handle, const char** names, const float** datas,
   return rc;
 }
 
+// Back-compat single-output form: run, then hand back fetch out_index.
+int pt_engine_run(void* handle, const char** names, const float** datas,
+                  const int64_t** shapes, const int32_t* ranks,
+                  int32_t n_inputs, int32_t out_index,
+                  const float** out_data, const int64_t** out_shape,
+                  int32_t* out_rank) {
+  int rc = pt_engine_run_all(handle, names, datas, shapes, ranks, n_inputs);
+  if (rc != 0) return rc;
+  return pt_engine_output(handle, out_index, out_data, out_shape, out_rank);
+}
+
 void pt_engine_destroy(void* handle) {
   auto* eng = static_cast<Engine*>(handle);
   if (!eng) return;
   PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(eng->last_result);
   Py_XDECREF(eng->engine);
   PyGILState_Release(gil);
   delete eng;

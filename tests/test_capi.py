@@ -26,14 +26,16 @@ def capi_lib():
 
 
 def test_capi_end_to_end(tmp_path, capi_lib):
-    # 1) export a deterministic linear model: y = x @ W (W = const 0.5)
+    # 1) export a deterministic linear model with TWO fetch targets:
+    #    y = x @ W (W = const 0.5) and z = 2*y (multi-output fetch)
     x = layers.data("x", shape=[4])
     pred = layers.fc(input=x, size=2, bias_attr=False,
                      param_attr=pt.initializer.Constant(0.5))
+    doubled = layers.scale(pred, scale=2.0)
     exe = pt.Executor()
     exe.run(pt.default_startup_program())
     model_dir = tmp_path / "model"
-    pt.io.save_inference_model(str(model_dir), ["x"], [pred], exe)
+    pt.io.save_inference_model(str(model_dir), ["x"], [pred, doubled], exe)
 
     # 2) compile the example C program
     exe_path = tmp_path / "infer"
@@ -62,8 +64,15 @@ def test_capi_end_to_end(tmp_path, capi_lib):
         capture_output=True, text=True, env=env, timeout=300,
     )
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
-    out = np.array([float(v) for v in r.stdout.split()]).reshape(2, 2)
+    # introspection lines on stderr: feed surface + both fetch targets
+    assert "input 0: x rank=2" in r.stderr, r.stderr
+    assert "output 0:" in r.stderr and "output 1:" in r.stderr, r.stderr
+    # stdout: "<output_index> <value>" per element, both outputs
+    rows = [line.split() for line in r.stdout.strip().splitlines()]
+    out0 = np.array([float(v) for i, v in rows if i == "0"]).reshape(2, 2)
+    out1 = np.array([float(v) for i, v in rows if i == "1"]).reshape(2, 2)
     want = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.float32) @ np.full(
         (4, 2), 0.5, np.float32
     )
-    np.testing.assert_allclose(out, want, rtol=1e-5)
+    np.testing.assert_allclose(out0, want, rtol=1e-5)
+    np.testing.assert_allclose(out1, 2.0 * want, rtol=1e-5)
