@@ -39,10 +39,19 @@ CONFIGS = {
                    ref="81.69 img/s bs64 Xeon (ResNet-50)", depth=50),
     "lstm": dict(batch=64, seq_len=100, hid=512, dict_dim=10000, classes=2,
                  ref="184 ms/batch bs64 h512 K40m"),
-    # NEW capability (no reference analog): flash-attention GPT LM;
+    # BASELINE config 3: seq2seq+attention NMT (reference
+    # demo/seqToseq-era model; no published perf number in-tree)
+    "seq2seq": dict(batch=64, seq_len=32, dict_dim=30000, word_dim=256,
+                    hid=512, ref="n/a (no published NMT number in-tree)"),
+    # BASELINE config 4: DeepSpeech2-style conv+BiGRU+CTC
+    "ds2": dict(batch=32, audio_len=256, feat_dim=161, rnn_size=256,
+                layers=3, vocab=29,
+                ref="n/a (no published DS2 number in-tree)"),
+    # NEW capability (no reference analog): flash-attention GPT LM —
+    # the ROUND-3 FLAGSHIP config (12L, d=768, 6x128 heads, t=4096);
     # items/s = sequences/s, so tokens/s = items/s * seq_len.
-    "gpt": dict(batch=8, seq_len=1024, vocab=32000, d_model=512, n_layer=8,
-                n_head=8, ref="n/a (reference predates transformers)"),
+    "gpt": dict(batch=8, seq_len=4096, vocab=32768, d_model=768, n_layer=12,
+                n_head=6, ref="n/a (reference predates transformers)"),
 }
 
 
@@ -56,6 +65,16 @@ def _build(name, cfg, dtype):
             outs = models.text_classification.build(
                 dict_dim=cfg["dict_dim"], class_dim=cfg["classes"],
                 hid_dim=cfg["hid"], max_len=cfg["seq_len"])
+        elif name == "seq2seq":
+            outs = models.seq2seq.build(
+                src_dict_size=cfg["dict_dim"], trg_dict_size=cfg["dict_dim"],
+                word_dim=cfg["word_dim"], hidden_dim=cfg["hid"],
+                max_len=cfg["seq_len"])
+        elif name == "ds2":
+            outs = models.deep_speech2.build(
+                feat_dim=cfg["feat_dim"], max_audio_len=cfg["audio_len"],
+                rnn_size=cfg["rnn_size"], num_rnn_layers=cfg["layers"],
+                vocab_size=cfg["vocab"])
         elif name == "gpt":
             outs = models.transformer.build(
                 vocab_size=cfg["vocab"], n_layer=cfg["n_layer"],
@@ -92,6 +111,27 @@ def _feed(name, cfg, dtype, rng):
         return {"words": jax.device_put(jnp.asarray(words)),
                 "words@LENGTH": jax.device_put(jnp.asarray(lens)),
                 "label": jax.device_put(jnp.asarray(label))}
+    if name == "seq2seq":
+        t = cfg["seq_len"]
+        mk = lambda: rng.integers(0, cfg["dict_dim"],
+                                  size=(batch, t)).astype(np.int64)
+        lens = jnp.asarray(np.full((batch,), t, np.int32))
+        feed = {}
+        for nm in ("src_word_id", "target_language_word",
+                   "target_language_next_word"):
+            feed[nm] = jax.device_put(jnp.asarray(mk()))
+            feed[nm + "@LENGTH"] = jax.device_put(lens)
+        return feed
+    if name == "ds2":
+        audio = rng.random(size=(batch, cfg["audio_len"], cfg["feat_dim"]),
+                           dtype=np.float32)
+        alen = np.full((batch,), cfg["audio_len"], np.int32)
+        lab = rng.integers(1, cfg["vocab"], size=(batch, 64)).astype(np.int64)
+        llen = np.full((batch,), 40, np.int32)
+        return {"audio": jax.device_put(jnp.asarray(audio)),
+                "audio@LENGTH": jax.device_put(jnp.asarray(alen)),
+                "transcript": jax.device_put(jnp.asarray(lab)),
+                "transcript@LENGTH": jax.device_put(jnp.asarray(llen))}
     img = rng.random(size=(batch, *cfg["image"]), dtype=np.float32)
     label = rng.integers(0, cfg["classes"], (batch, 1)).astype(np.int64)
     jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
@@ -104,12 +144,16 @@ def bench_one(name, steps, warmup, dtype):
 
     cfg = CONFIGS[name]
     main, startup, outs = _build(name, cfg, dtype)
-    exe = pt.Executor()
-    exe.run(startup)
-    rng = np.random.default_rng(0)
-    feed = _feed(name, cfg, dtype, rng)
-    fetch = [outs["avg_cost"]]
-    dt, cost = timed_steps(exe, main, feed, fetch, steps, warmup)
+    # fresh scope per config: otherwise every config's params+optimizer
+    # state stay live on the chip for the whole sweep and the big ones
+    # (gpt) OOM
+    with pt.core.scope.scope_guard(pt.Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        feed = _feed(name, cfg, dtype, rng)
+        fetch = [outs["avg_cost"]]
+        dt, cost = timed_steps(exe, main, feed, fetch, steps, warmup)
     assert np.isfinite(cost[0]).all()
     ms = dt / steps * 1000.0
     return {
