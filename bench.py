@@ -106,17 +106,22 @@ def bench_gpt(n_chips, mesh_factory, steps, warmup):
     vocab = int(os.environ.get("BENCH_GPT_VOCAB", "32768"))
     batch = int(os.environ.get("BENCH_GPT_BATCH", "8"))
 
+    fused = os.environ.get("BENCH_GPT_FUSED_HEAD", "1").lower() not in (
+        "0", "", "false")
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         outs = transformer.build(
             vocab_size=vocab, n_layer=n_layer, n_head=n_head,
             d_model=d_model, max_len=seq, dropout_rate=0.0,
-            dtype="bfloat16")
-        if os.environ.get("BENCH_GPT_REMAT", "0").lower() not in (
-                "0", "", "false"):
-            # remat costs ~23% at this size and the activations fit on a
-            # 16 GB chip without it; the knob exists for bigger configs
-            pt.memory_optimize(main_prog)
+            dtype="bfloat16", fused_head=fused)
+        remat = os.environ.get("BENCH_GPT_REMAT", "0").lower()
+        if remat not in ("0", "", "false"):
+            # selective (default): saves kernel residuals + MXU outputs,
+            # recomputes only VPU-cheap ops (LN/gelu/residuals); compact
+            # also remats the matmuls; full remats everything incl. flash
+            # (the capacity mode — see RESULTS.md round-4 table)
+            policy = remat if remat in ("full", "compact") else "selective"
+            pt.memory_optimize(main_prog, policy=policy)
     mesh = mesh_factory(main_prog, startup)
     if mesh is not None:
         batch *= n_chips

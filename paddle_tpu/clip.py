@@ -168,7 +168,8 @@ def error_clip_callback(var, clip_attr):
     segs = getattr(block.program, "_remat_segments", None)
     if segs:
         block.program._remat_segments = [
-            (s + (pos <= s), t_ + (pos <= t_)) for s, t_ in segs
+            (seg[0] + (pos <= seg[0]), seg[1] + (pos <= seg[1]), *seg[2:])
+            for seg in segs
         ]
     block.program._bump_version()
     return clipped

@@ -35,6 +35,71 @@ from .scope import Scope, global_scope, RNG_VAR
 from .place import CPUPlace, TPUPlace
 
 
+def _remat_segment(seg_fn, env, param_names=()):
+    """``jax.checkpoint``-equivalent for one forward segment whose backward
+    recompute is made DATA-DEPENDENT on the incoming cotangents via
+    ``optimization_barrier``.
+
+    Plain ``jax.checkpoint`` on a flat (unrolled) layer stack lets XLA's
+    scheduler hoist every segment's rematted forward to the start of the
+    backward — all layers' recomputed activations end up live at once and
+    remat saves nothing (measured: GPT t=16k bs8 sat at 22.6 GB with the
+    OOM dump showing 10+ rematted 768 MB FFN tiles alive together).
+    ``lax.scan`` over layers is the canonical fix, but a Program is an
+    unrolled op list; the barrier gives the same serialization — segment
+    k's recompute cannot start until segment k+1's backward has produced
+    k's output cotangents."""
+
+    def _inexact(x):
+        try:
+            return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+        except TypeError:
+            return False
+
+    @jax.custom_vjp
+    def run(env):
+        return seg_fn(env)
+
+    def run_fwd(env):
+        return seg_fn(env), env
+
+    def run_bwd(env, ct):
+        fkeys = sorted(k for k, v in env.items() if _inexact(v))
+        ckeys = sorted(k for k, v in ct.items() if _inexact(v))
+        env_f, ct_f = jax.lax.optimization_barrier(
+            ([env[k] for k in fkeys], [ct[k] for k in ckeys]))
+        env2 = dict(env)
+        env2.update(zip(fkeys, env_f))
+        ct2 = dict(ct)
+        ct2.update(zip(ckeys, ct_f))
+        _, vjp_fn = jax.vjp(seg_fn, env2)
+        (denv,) = vjp_fn(ct2)
+        # Tie the outgoing activation cotangents to this segment's weight
+        # gradients with a REAL data dependency.  Without it XLA defers
+        # every segment's dW matmuls (nothing consumes dW until the
+        # optimizer at the very end), keeping their big recomputed
+        # operands alive across the whole backward — measured as 12+
+        # concurrent 768 MB tiles on GPT t=16k bs8, which nullified remat
+        # entirely.  (A multi-operand optimization_barrier did NOT stop
+        # the deferral.)  `tie = s - s` is exactly 0.0 for finite grads
+        # but not constant-foldable for floats, so the residual-stream
+        # cotangent that unblocks the previous segment's backward now
+        # requires every dW of this segment to be finished.
+        pkeys = [k for k in param_names if k in denv
+                 and _inexact(denv[k])]
+        if pkeys:
+            s = sum(jnp.sum(denv[k].astype(jnp.float32)) for k in pkeys)
+            tie = s - s
+            denv = dict(denv)
+            for k, v in denv.items():
+                if k not in param_names and _inexact(v):
+                    denv[k] = v + tie.astype(v.dtype)
+        return (denv,)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(env)
+
+
 class LoweringCtx:
     """Passed to raw (control-flow) op implementations so they can lower
     sub-blocks with the same machinery."""
@@ -420,14 +485,53 @@ class Executor:
                         )
                     else:
                         # memory_optimize marked remat boundaries: run each
-                        # forward segment under jax.checkpoint so backward
-                        # recomputes activations instead of storing them.
-                        for s, t in segments:
+                        # wrapped forward segment under jax.checkpoint so
+                        # backward recomputes activations instead of
+                        # storing them; unwrapped segments (the selective
+                        # policy's expensive ops — flash attention etc.)
+                        # run plainly so their residuals stay saved.
+                        #
+                        # A wrapped segment may only return names consumed
+                        # AFTER it (later forward ops, the loss, aux):
+                        # returning everything it writes would thread every
+                        # internal activation into the next segment's
+                        # inputs, where jax.checkpoint saves it as a
+                        # residual — remat would then recompute for zero
+                        # memory saved (measured: t=16k bs8 GPT sat at
+                        # 23.5 GB, OOM, regardless of policy).
+                        def op_uses(op_, acc, seen):
+                            for slot_names in op_.inputs.values():
+                                acc.update(slot_names)
+                            sub = op_.attrs.get("sub_block")
+                            if sub is not None and sub not in seen:
+                                seen.add(sub)
+                                for sop in program.block(sub).ops:
+                                    op_uses(sop, acc, seen)
+
+                        needed_after = [set(aux_names)
+                                        | {info["loss"]}]
+                        for op_ in reversed(block.ops[:bw]):
+                            nxt = set(needed_after[-1])
+                            op_uses(op_, nxt, set())
+                            needed_after.append(nxt)
+                        needed_after.reverse()  # needed_after[i] = used
+                        # by ops[i:] (+loss/aux); index bw == just aux
+
+                        for seg in segments:
+                            s, t = seg[0], seg[1]
+                            wrap = seg[2] if len(seg) > 2 else True
                             seg_ops = block.ops[s:t]
+                            if not wrap:
+                                run_block_ops(
+                                    ctx, block, seg_ops, e,
+                                    inside_grad_prefix=True,
+                                )
+                                continue
                             written = {
                                 n for op in seg_ops for n in op.output_names()
                             }
-                            out_names = tuple(sorted(written))
+                            out_names = tuple(sorted(
+                                written & needed_after[t]))
 
                             # checkpoint may trace seg_fn more than once;
                             # pin the random-op key counter to the segment
@@ -444,7 +548,15 @@ class Executor:
                                 )
                                 return {n: e2[n] for n in _out if n in e2}
 
-                            outs = jax.checkpoint(seg_fn)(e)
+                            seg_uses = set()
+                            for op_ in seg_ops:
+                                op_uses(op_, seg_uses, set())
+                            env_sub = {
+                                k: e[k] for k in sorted(seg_uses) if k in e
+                            }
+                            outs = _remat_segment(
+                                seg_fn, env_sub,
+                                param_names=frozenset(param_names))
                             e.update(outs)
                     loss = e[info["loss"]]
                     aux = {n: e[n] for n in aux_names if n in e}

@@ -41,6 +41,7 @@ __all__ = [
     "sequence_softmax",
     "softmax",
     "softmax_with_cross_entropy",
+    "fused_softmax_ce_head",
     "sigmoid_cross_entropy_with_logits",
     "smooth_l1",
     "matmul",
@@ -629,6 +630,31 @@ def softmax_with_cross_entropy(logits, label, soft_label=False):
         inputs={"Logits": [logits.name], "Label": [label.name]},
         outputs={"Softmax": [softmax_out.name], "Loss": [loss.name]},
         attrs={"soft_label": soft_label},
+    )
+    return loss
+
+
+def fused_softmax_ce_head(input, label, size, param_attr=None, name=None,
+                          block_n=512, block_v=1024):
+    """Fused LM-head loss: projection [d -> size] + softmax cross-entropy
+    in one Pallas kernel that never materializes ``[..., size]`` logits in
+    HBM (``ops/pallas_ce.py``).  Replaces the composed
+    ``fc(bias_attr=False) + softmax_with_cross_entropy`` head (the
+    reference's ``softmax_with_cross_entropy_op.cc`` path) for large
+    vocabularies.  Returns per-position loss ``[..., 1]`` float32; rows
+    with out-of-range labels (ignore_index) must be masked by the caller,
+    exactly like the composed path."""
+    helper = LayerHelper("fused_softmax_ce_head", name=name)
+    in_dim = int(input.shape[-1])
+    w = helper.create_parameter(
+        param_attr, shape=[in_dim, size], dtype=input.dtype, suffix="w")
+    loss = helper.create_tmp_variable(
+        "float32", list(input.shape[:-1]) + [1])
+    helper.append_op(
+        type="fused_softmax_ce_head",
+        inputs={"X": [input.name], "W": [w.name], "Label": [label.name]},
+        outputs={"Loss": [loss.name]},
+        attrs={"block_n": block_n, "block_v": block_v},
     )
     return loss
 

@@ -125,20 +125,102 @@ def _cut_cost(graph, i, exclude):
     )
 
 
+# op types whose forward is too expensive to recompute in backward: every
+# remat policy keeps them OUTSIDE jax.checkpoint wrappers so their
+# custom-VJP residuals (e.g. flash attention's o + lse, the fused CE
+# head's lse) stay saved and the kernels never re-run.
+EXPENSIVE_OPS = ("flash_attention", "fused_softmax_ce_head", "scan_block",
+                 "nested_rnn", "warpctc")
+
+# MXU ops: the selective policy also keeps these saved — on TPU the right
+# recompute set is the VPU-cheap tail (LN, activations, residual adds,
+# dropout), which hides under the backward matmuls; re-running MXU work
+# costs real step time (measured −17% when projections/FFN matmuls are
+# rematerialized on the GPT flagship vs −4% recomputing only VPU ops).
+MXU_OPS = ("mul", "matmul", "conv2d", "conv3d", "depthwise_conv2d",
+           "conv2d_transpose", "conv3d_transpose")
+
+
 def memory_optimize(input_program=None, num_segments=None, min_segment=2,
-                    level=0, print_log=False):
+                    level=0, print_log=False, policy="selective",
+                    expensive_ops=None):
     """Mark remat segments on the forward prefix of ``input_program``
-    (in place, like the reference).  ``num_segments`` defaults to
-    ~sqrt(#forward ops).  Returns the chosen segment boundaries."""
+    (in place, like the reference — the TPU translation of the liveness
+    judgment in ``memory_optimization_transpiler.py:33``).
+
+    ``policy="selective"`` (default): maximal runs of VPU-cheap ops
+    (layer norm, activations, residual adds, dropout) are wrapped in
+    ``jax.checkpoint`` — backward recomputes them under the shadow of the
+    backward matmuls; kernel ops (flash attention, the fused CE head) and
+    MXU ops (projections, FFN matmuls, convs) stay unwrapped with their
+    outputs/residuals saved.  Frees the elementwise activations (the
+    gelu/LN/residual tensors — the bulk by count) at a few percent step
+    cost.
+
+    ``policy="compact"``: only kernel ops stay saved; matmuls are
+    rematerialized too.  Maximum memory saving (only kernel residuals +
+    segment boundaries survive) at ~15-17% step cost — the
+    bigger-than-memory lever (t=16k+ flagship shapes).
+
+    ``policy="full"``: the round-2 all-or-nothing behavior — sqrt-N
+    liveness-minimal cuts, every segment rematerialized (recomputes flash
+    too; measured −23% on the GPT flagship, RESULTS.md).
+
+    Returns the segment list ``[(start, end, wrapped), ...]`` tiling the
+    forward prefix."""
     from .core.program import default_main_program
 
     program = input_program or default_main_program()
     block = program.global_block()
+    if policy not in ("selective", "compact", "full"):
+        raise ValueError(
+            f"memory_optimize policy must be 'selective', 'compact' or "
+            f"'full', got {policy!r}")
     bw = block.backward_index
     n_fwd = bw if bw is not None else len(block.ops)
     if n_fwd < 2 * min_segment:
         program._remat_segments = []
         return []
+
+    if expensive_ops is None:
+        expensive_ops = EXPENSIVE_OPS
+        if policy == "selective":
+            expensive_ops = EXPENSIVE_OPS + MXU_OPS
+    expensive_at = [
+        i for i in range(n_fwd) if block.ops[i].type in expensive_ops
+    ]
+    if policy in ("selective", "compact") and expensive_at:
+        segments = []
+        pos = 0
+        for i in expensive_at:
+            if i > pos:
+                segments.append((pos, i, True))
+            segments.append((i, i + 1, False))
+            pos = i + 1
+        if pos < n_fwd:
+            segments.append((pos, n_fwd, True))
+        # wrapping a tiny tail saves nothing and costs a checkpoint trace
+        segments = [
+            (s, t, wrap and (t - s) >= min_segment)
+            for s, t, wrap in segments
+        ]
+        # merge adjacent unwrapped segments (runs of saved ops) so the
+        # executor sees few, large segments instead of op-sized slivers
+        merged = []
+        for seg in segments:
+            if (merged and not seg[2] and not merged[-1][2]
+                    and merged[-1][1] == seg[0]):
+                merged[-1] = (merged[-1][0], seg[1], False)
+            else:
+                merged.append(seg)
+        segments = [tuple(s) for s in merged]
+        program._remat_segments = segments
+        program._bump_version()
+        if print_log:
+            n_wrap = sum(1 for _, _, w in segments if w)
+            print(f"memory_optimize[{policy}]: {len(segments)} segments, "
+                  f"{n_wrap} wrapped, expensive at {expensive_at}")
+        return segments
 
     graph = ControlFlowGraph(program, 0, block.ops[:n_fwd])
     k = num_segments or max(2, int(math.isqrt(n_fwd)))
@@ -160,7 +242,7 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
     cuts = sorted(cuts)
     bounds = [0] + cuts + [n_fwd]
     segments = [
-        (bounds[j], bounds[j + 1]) for j in range(len(bounds) - 1)
+        (bounds[j], bounds[j + 1], True) for j in range(len(bounds) - 1)
         if bounds[j + 1] > bounds[j]
     ]
     program._remat_segments = segments

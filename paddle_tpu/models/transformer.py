@@ -33,9 +33,11 @@ def transformer_block(x, d_model, n_head, d_ff, dropout_rate, is_test,
     return x + ff
 
 
-def gpt(tokens, vocab_size, n_layer=4, n_head=8, d_model=256, d_ff=None,
-        max_len=128, dropout_rate=0.1, is_test=False, dtype="bfloat16"):
-    """Causal LM trunk: returns [batch, time, vocab] logits (float32)."""
+def gpt_trunk(tokens, vocab_size, n_layer=4, n_head=8, d_model=256,
+              d_ff=None, max_len=128, dropout_rate=0.1, is_test=False,
+              dtype="bfloat16"):
+    """Causal LM trunk up to the final layer norm: [batch, time, d_model]
+    hidden states in ``dtype`` (the head is attached by the caller)."""
     d_ff = d_ff or 4 * d_model
     b, t = tokens.shape[0], tokens.shape[1]
     emb = layers.embedding(tokens, size=[vocab_size, d_model],
@@ -49,7 +51,15 @@ def gpt(tokens, vocab_size, n_layer=4, n_head=8, d_model=256, d_ff=None,
     for i in range(n_layer):
         x = transformer_block(x, d_model, n_head, d_ff, dropout_rate,
                               is_test, name=f"block{i}")
-    x = layers.layer_norm(x, begin_norm_axis=2, name="ln_f")
+    return layers.layer_norm(x, begin_norm_axis=2, name="ln_f")
+
+
+def gpt(tokens, vocab_size, n_layer=4, n_head=8, d_model=256, d_ff=None,
+        max_len=128, dropout_rate=0.1, is_test=False, dtype="bfloat16"):
+    """Causal LM trunk: returns [batch, time, vocab] logits (float32)."""
+    x = gpt_trunk(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
+                  d_model=d_model, d_ff=d_ff, max_len=max_len,
+                  dropout_rate=dropout_rate, is_test=is_test, dtype=dtype)
     logits = layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False,
                        name="lm_head")
     return ltensor.cast(logits, "float32")
@@ -185,27 +195,47 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
 
 def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
           max_len=128, dropout_rate=0.1, is_test=False,
-          learning_rate=1e-3, dtype="bfloat16"):
+          learning_rate=1e-3, dtype="bfloat16", fused_head=False):
     """Next-token-prediction training program.
 
     Feeds: tokens [batch, max_len] int64, labels [batch, max_len] int64
     (tokens shifted left by one, label -1 = padding, masked out of the
-    loss)."""
+    loss).
+
+    ``fused_head=True`` replaces the fc + softmax_with_cross_entropy head
+    with the Pallas fused head (``layers.fused_softmax_ce_head``): no
+    ``[b, t, vocab]`` logits ever hit HBM, which is the difference between
+    an HBM-bound and an MXU-bound loss at 32k-vocab flagship shapes.  The
+    head weight keeps the name/shape ``lm_head.w [d_model, vocab]`` either
+    way, so ``generate`` serves both.  With the fused head ``logits`` is
+    None (not materializing them is the point)."""
     tokens = layers.data("tokens", shape=[max_len], dtype="int64")
     labels = layers.data("labels", shape=[max_len], dtype="int64")
-    logits = gpt(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
-                 d_model=d_model, d_ff=d_ff, max_len=max_len,
-                 dropout_rate=dropout_rate, is_test=is_test, dtype=dtype)
-    flat_logits = ltensor.reshape(logits, [-1, vocab_size])
-    flat_labels = ltensor.reshape(labels, [-1, 1])
-    mask = ltensor.cast(
-        layers.greater_equal(flat_labels, ltensor.fill_constant(
+    mask2d = ltensor.cast(
+        layers.greater_equal(labels, ltensor.fill_constant(
             shape=[1], dtype="int64", value=0)), "float32")
-    safe_labels = layers.elementwise_max(
-        flat_labels, ltensor.fill_constant(shape=[1], dtype="int64",
-                                           value=0))
-    loss = layers.softmax_with_cross_entropy(flat_logits, safe_labels)
-    masked = loss * mask
+    safe2d = layers.elementwise_max(
+        labels, ltensor.fill_constant(shape=[1], dtype="int64", value=0))
+    logits = None
+    if fused_head:
+        x = gpt_trunk(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
+                      d_model=d_model, d_ff=d_ff, max_len=max_len,
+                      dropout_rate=dropout_rate, is_test=is_test,
+                      dtype=dtype)
+        loss = layers.fused_softmax_ce_head(x, safe2d, vocab_size,
+                                            name="lm_head")
+        masked = ltensor.reshape(loss, [-1, 1]) * ltensor.reshape(
+            mask2d, [-1, 1])
+    else:
+        logits = gpt(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
+                     d_model=d_model, d_ff=d_ff, max_len=max_len,
+                     dropout_rate=dropout_rate, is_test=is_test,
+                     dtype=dtype)
+        flat_logits = ltensor.reshape(logits, [-1, vocab_size])
+        flat_labels = ltensor.reshape(safe2d, [-1, 1])
+        loss = layers.softmax_with_cross_entropy(flat_logits, flat_labels)
+        masked = loss * ltensor.reshape(mask2d, [-1, 1])
+    mask = ltensor.reshape(mask2d, [-1, 1])
     avg_cost = layers.reduce_sum(masked) / (
         layers.reduce_sum(mask) + 1e-8)
     optimizer = opt.Adam(learning_rate=learning_rate)

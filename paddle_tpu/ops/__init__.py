@@ -26,3 +26,4 @@ from . import ctc_ops
 from . import crf_ops
 from . import io_ops
 from . import pallas_attention
+from . import pallas_ce

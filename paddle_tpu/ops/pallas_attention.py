@@ -123,7 +123,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         l_fin = l_scr[...]
         l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
         o_ref[0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[...] + jnp.log(l_safe)
+        # narrow [bq, 1] store (Mosaic masked store) — the residual /
+        # ring-merge layout, 4 B/row instead of a 512 B replicated tile
+        lse_ref[0] = (m_scr[...] + jnp.log(l_safe))[:, :1]
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -155,19 +157,21 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t_q, LSE_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
-    # lse stays lane-replicated [bh, t_q, LSE_LANES] — it is the backward
-    # kernels' residual in exactly this layout (512 B/row f32; ~1 GiB at
-    # the 64k benchmark config, the price of Mosaic-friendly tiling)
-    return o, lse
+    # lse leaves the kernel [bh, t_q, 1] but is squeezed to 2-D [bh, t_q]
+    # immediately: a trailing size-1 dim gets tile-padded back to 128
+    # lanes by XLA's T(8,128) layout (402 MB/layer at t=16k bs8 — exactly
+    # the lane-replicated waste again, just hidden in padding).  The 2-D
+    # form is compact; backward re-expands it transiently.
+    return o, lse[:, :, 0]
 
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, nk,
@@ -317,12 +321,97 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, nq,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
-               interpret, dlse=None):
-    """Pallas backward: dq kernel (q-major) + dk/dv kernel (k-major),
-    both with causal block skip; O(block^2) VMEM.  ``dlse`` (lane-
-    replicated [bh, t_q, LSE_LANES], optional) is the cotangent of the
-    returned lse for callers that consume it (ring-attention merges)."""
+def _bwd_fused_kernel(*refs, sm_scale, causal, block_q, block_k, nq,
+                      has_dlse):
+    """Single-pass backward: grid (bh, k-blocks, q-blocks), q innermost.
+    Computes the s/p tile ONCE per (k, q) block pair (the split dq + dkv
+    kernels each recompute it — 7 block matmuls per pair vs 5 here) and
+    emits dk/dv via VMEM accumulators plus dq as per-k-block partials
+    ``dq_part[kb]`` that the caller reduces over kb.  Used when the
+    partial buffer is small (nk grows with t; the split kernels remain
+    the long-context path)."""
+    import jax.experimental.pallas as pl
+
+    if has_dlse:
+        (k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref, dlse_ref,
+         dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
+         dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        dlse_ref = None
+
+    kb = pl.program_id(1)
+    jq = pl.program_id(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    def _block(masked):
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True)
+        if dlse_ref is not None:
+            delta = delta - dlse_ref[0][:, :1]
+        bq = q.shape[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if masked:
+            q_pos = jq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, :1])
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, :1]) * sm_scale).astype(q.dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dqp_ref.dtype)
+
+    if causal:
+        on = jq >= (kb * block_k) // block_q
+        unmasked = jq * block_q >= (kb + 1) * block_k - 1
+        pl.when(jnp.logical_and(on, unmasked))(lambda: _block(False))
+        pl.when(jnp.logical_and(on, jnp.logical_not(unmasked)))(
+            lambda: _block(True))
+
+        # skipped cells still own their dq_part block — zero it so the
+        # caller's reduce over kb sees no garbage
+        @pl.when(jnp.logical_not(on))
+        def _zero():
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+    else:
+        _block(False)
+
+    @pl.when(jq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# fused-backward dq partials budget: [nk, bh, t, d] must stay under this
+# (past it — long t — the split dq/dkv kernels take over)
+FUSED_BWD_PARTIAL_BYTES = 512 << 20
+
+
+def _flash_bwd_fused(q, k, v, o, lse, do, sm_scale, causal, block_q,
+                     block_k, interpret, dlse=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -334,9 +423,66 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
     nk = t_k // block_k
     has_dlse = dlse is not None
 
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, kb, jq: (i, kb, 0))
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, kb, jq: (i, jq, 0))
+    qstat = pl.BlockSpec((1, block_q, 1), lambda i, kb, jq: (i, jq, 0))
+    in_specs = [kspec, kspec, qspec, qspec, qspec, qstat]
+    args = [k, v, q, do, o, lse]
+    if has_dlse:
+        in_specs.append(qstat)
+        args.append(dlse)
+    dq_part, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          nq=nq, has_dlse=has_dlse),
+        grid=(bh, nk, nq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda i, kb, jq: (kb, i, jq, 0)),
+            kspec, kspec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nk, bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    dq = jnp.sum(dq_part.astype(jnp.float32), axis=0).astype(q.dtype)
+    return dq, dk, dv
+
+
+def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+               interpret, dlse=None):
+    """Pallas backward.  Short/medium t: one fused kernel (s recomputed
+    once per block pair, dq as per-k-block partials).  Long t (partials
+    over budget): dq kernel (q-major) + dk/dv kernel (k-major), both with
+    causal block skip; O(block^2) VMEM.  ``lse`` and the optional ``dlse``
+    (the cotangent of the returned lse, for callers that consume it —
+    ring-attention merges) arrive in the narrow [bh, t_q, 1] residual
+    layout."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = _pick_block(t_q, block_q)
+    block_k = _pick_block(t_k, block_k)
+    nq = t_q // block_q
+    nk = t_k // block_k
+    has_dlse = dlse is not None
+
+    part_bytes = nk * bh * t_q * d * q.dtype.itemsize
+    if part_bytes <= FUSED_BWD_PARTIAL_BYTES:
+        return _flash_bwd_fused(q, k, v, o, lse, do, sm_scale, causal,
+                                block_q, block_k, interpret, dlse=dlse)
+
     qspec = pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0))
-    qstat = pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j, kb: (i, j, 0))
+    qstat = pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0))
     dq_in_specs = [qspec, kspec, kspec, qspec, qspec, qstat]
     dq_args = [q, k, v, do, o, lse]
     if has_dlse:
@@ -357,8 +503,7 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
 
     kspec2 = pl.BlockSpec((1, block_k, d), lambda i, kb, jq: (i, kb, 0))
     qspec2 = pl.BlockSpec((1, block_q, d), lambda i, kb, jq: (i, jq, 0))
-    qstat2 = pl.BlockSpec((1, block_q, LSE_LANES),
-                          lambda i, kb, jq: (i, jq, 0))
+    qstat2 = pl.BlockSpec((1, block_q, 1), lambda i, kb, jq: (i, jq, 0))
     dkv_in_specs = [kspec2, kspec2, qspec2, qspec2, qspec2, qstat2]
     dkv_args = [k, v, q, do, o, lse]
     if has_dlse:
@@ -393,8 +538,8 @@ def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q,
-                      block_k, interpret)
+    return _flash_bwd(q, k, v, o, lse[:, :, None], do, sm_scale, causal,
+                      block_q, block_k, interpret)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -427,25 +572,23 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=1024,
 def _flash_core_lse(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                         interpret)
-    return o, lse[:, :, 0]
+    return o, lse
 
 
 def _flash_core_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                         interpret):
     o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                         interpret)
-    return (o, lse[:, :, 0]), (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_core_lse_bwd(sm_scale, causal, block_q, block_k, interpret,
                         res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
-    bh, t_q, _ = q.shape
-    dlse_rep = jnp.broadcast_to(
-        dlse.astype(jnp.float32)[:, :, None], (bh, t_q, LSE_LANES))
-    return _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q,
-                      block_k, interpret, dlse=dlse_rep)
+    return _flash_bwd(q, k, v, o, lse[:, :, None], do, sm_scale, causal,
+                      block_q, block_k, interpret,
+                      dlse=dlse.astype(jnp.float32)[:, :, None])
 
 
 _flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)

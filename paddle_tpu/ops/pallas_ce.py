@@ -1,0 +1,275 @@
+"""Fused softmax-cross-entropy head as Pallas TPU kernels.
+
+The reference composes the LM head from a projection plus
+``softmax_with_cross_entropy`` (``paddle/operators/
+softmax_with_cross_entropy_op.cc``), which materializes the full
+``[tokens, vocab]`` logits — at the GPT flagship shape (32k tokens x 32k
+vocab) that is ~2 GiB of bf16 logits plus the saved softmax, all HBM
+traffic.  This kernel fuses projection -> log-softmax -> NLL the flash
+way: the vocab axis is tiled, logit tiles live only in VMEM, an online
+max/sum carries the softmax state across vocab tiles, and the label's
+logit is picked up by an iota==label select in the visited tile.  HBM
+residual is O(tokens) (the lane-replicated lse rows), never O(tokens x
+vocab).
+
+Backward mirrors flash: two Pallas kernels recompute the probability
+tiles from the saved lse — dx (row-major grid, vocab innermost,
+accumulating ``ds @ W^T`` in VMEM) and dW (vocab-major grid, rows
+innermost, accumulating ``X^T @ ds``), with ``ds = (p - onehot) * g``.
+MXU feeds stay in the input dtype (bf16 in = 2x the f32 MXU rate);
+softmax state and accumulators are f32.
+
+Layout: x [N, d] activations, w [d, v] head weight, labels [N] int.
+Rows with out-of-range labels (e.g. ignore_index -1) produce a finite
+garbage loss that callers mask out; their gradients vanish because the
+masked loss contributes a zero cotangent.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .pallas_attention import _pick_block
+
+LANES = 128  # Mosaic min lane tile; per-row stats are lane-replicated
+
+
+def _ce_fwd_kernel(x_ref, w_ref, y_ref, loss_ref, lse_ref,
+                   m_scr, l_scr, pick_scr, *, block_v, nv):
+    """One (row-block, vocab-block) grid cell; vocab is the innermost grid
+    axis so online-softmax state carries across vocab tiles in VMEM."""
+    import jax.experimental.pallas as pl
+
+    jv = pl.program_id(1)
+
+    @pl.when(jv == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        pick_scr[...] = jnp.zeros_like(pick_scr[...])
+
+    x = x_ref[...]                      # [bn, d] input dtype
+    w = w_ref[...]                      # [d, bv]
+    s = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [bn, bv] f32
+    m_prev = m_scr[...]
+    m2 = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m2)
+    p = jnp.exp(s - m2[:, :1])
+    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[...] = m2
+    y = y_ref[...]                      # [bn, 1] int32
+    col = jv * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    pick_scr[...] += jnp.sum(
+        jnp.where(col == y, s, 0.0), axis=-1, keepdims=True)
+
+    @pl.when(jv == nv - 1)
+    def _finalize():
+        lse = m_scr[...] + jnp.log(l_scr[...])
+        lse_ref[...] = lse[:, :1]
+        loss_ref[...] = (lse - pick_scr[...])[:, :1]
+
+
+def _ce_dx_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dx_ref, dx_scr,
+                  *, block_v, nv):
+    """dx: grid (row-blocks, vocab-blocks), vocab innermost; recompute the
+    probability tile from lse, ds = (p - onehot) * g, dx += ds @ W^T."""
+    import jax.experimental.pallas as pl
+
+    jv = pl.program_id(1)
+
+    @pl.when(jv == 0)
+    def _init():
+        dx_scr[...] = jnp.zeros_like(dx_scr[...])
+
+    x = x_ref[...]
+    w = w_ref[...]
+    s = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    p = jnp.exp(s - lse_ref[...][:, :1])
+    col = jv * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    onehot = (col == y_ref[...]).astype(jnp.float32)
+    ds = ((p - onehot) * g_ref[...][:, :1]).astype(w.dtype)
+    dx_scr[...] += jax.lax.dot_general(
+        ds, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(jv == nv - 1)
+    def _finalize():
+        dx_ref[...] = dx_scr[...].astype(dx_ref.dtype)
+
+
+def _ce_dw_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dw_ref, dw_scr,
+                  *, block_v, nn):
+    """dW: grid (vocab-blocks, row-blocks), rows innermost; dW += X^T @ ds
+    accumulated across row tiles in VMEM."""
+    import jax.experimental.pallas as pl
+
+    jv = pl.program_id(0)
+    jn = pl.program_id(1)
+
+    @pl.when(jn == 0)
+    def _init():
+        dw_scr[...] = jnp.zeros_like(dw_scr[...])
+
+    x = x_ref[...]
+    w = w_ref[...]
+    s = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    p = jnp.exp(s - lse_ref[...][:, :1])
+    col = jv * s.shape[1] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    onehot = (col == y_ref[...]).astype(jnp.float32)
+    ds = ((p - onehot) * g_ref[...][:, :1]).astype(x.dtype)
+    dw_scr[...] += jax.lax.dot_general(
+        x, ds, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(jn == nn - 1)
+    def _finalize():
+        dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
+
+
+def _ce_fwd(x, w, y, block_n, block_v, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, d = x.shape
+    v = w.shape[1]
+    bn = _pick_block(n, block_n)
+    bv = _pick_block(v, block_v)
+    nv = v // bv
+    y2 = y.reshape(n, 1)
+
+    loss, lse = pl.pallas_call(
+        functools.partial(_ce_fwd_kernel, block_v=bv, nv=nv),
+        grid=(n // bn, nv),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, jv: (i, 0)),
+            pl.BlockSpec((d, bv), lambda i, jv: (0, jv)),
+            pl.BlockSpec((bn, 1), lambda i, jv: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, 1), lambda i, jv: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, jv: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, LANES), jnp.float32),  # m
+            pltpu.VMEM((bn, LANES), jnp.float32),  # l
+            pltpu.VMEM((bn, LANES), jnp.float32),  # picked label logit
+        ],
+        interpret=interpret,
+    )(x, w, y2)
+    # squeeze to 1-D immediately: the [n, 1] kernel buffers get tile-
+    # padded to 128 lanes by XLA's layout; the 1-D forms are compact
+    return loss[:, 0], lse[:, 0]
+
+
+def _ce_bwd(x, w, y, lse, g, block_n, block_v, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, d = x.shape
+    v = w.shape[1]
+    bn = _pick_block(n, block_n)
+    bv = _pick_block(v, block_v)
+    nn_ = n // bn
+    nv = v // bv
+    y2 = y.reshape(n, 1)
+    lse = lse.reshape(n, 1)
+    g2 = g.astype(jnp.float32).reshape(n, 1)
+
+    xspec = pl.BlockSpec((bn, d), lambda i, jv: (i, 0))
+    wspec = pl.BlockSpec((d, bv), lambda i, jv: (0, jv))
+    rstat = pl.BlockSpec((bn, 1), lambda i, jv: (i, 0))
+    dx = pl.pallas_call(
+        functools.partial(_ce_dx_kernel, block_v=bv, nv=nv),
+        grid=(nn_, nv),
+        in_specs=[xspec, wspec, rstat, rstat, rstat],
+        out_specs=[xspec],
+        out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype)],
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        interpret=interpret,
+    )(x, w, y2, lse, g2)[0]
+
+    xspec2 = pl.BlockSpec((bn, d), lambda jv, jn: (jn, 0))
+    wspec2 = pl.BlockSpec((d, bv), lambda jv, jn: (0, jv))
+    rstat2 = pl.BlockSpec((bn, 1), lambda jv, jn: (jn, 0))
+    dw = pl.pallas_call(
+        functools.partial(_ce_dw_kernel, block_v=bv, nn=nn_),
+        grid=(nv, nn_),
+        in_specs=[xspec2, wspec2, rstat2, rstat2, rstat2],
+        out_specs=[pl.BlockSpec((d, bv), lambda jv, jn: (0, jv))],
+        out_shape=[jax.ShapeDtypeStruct((d, v), w.dtype)],
+        scratch_shapes=[pltpu.VMEM((d, bv), jnp.float32)],
+        interpret=interpret,
+    )(x, w, y2, lse, g2)[0]
+    return dx, dw
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ce_core(x, w, y, blocks, interpret):
+    loss, _ = _ce_fwd(x, w, y, blocks[0], blocks[1], interpret)
+    return loss
+
+
+def _ce_core_fwd(x, w, y, blocks, interpret):
+    loss, lse = _ce_fwd(x, w, y, blocks[0], blocks[1], interpret)
+    return loss, (x, w, y, lse)
+
+
+def _ce_core_bwd(blocks, interpret, res, g):
+    x, w, y, lse = res
+    dx, dw = _ce_bwd(x, w, y, lse, g, blocks[0], blocks[1], interpret)
+    return dx, dw, np.zeros(y.shape, jax.dtypes.float0)
+
+
+_ce_core.defvjp(_ce_core_fwd, _ce_core_bwd)
+
+
+def fused_softmax_ce_head(x, w, labels, block_n=512, block_v=1024,
+                          interpret=None):
+    """Fused projection + softmax cross-entropy: ``x [..., d]``,
+    ``w [d, v]``, ``labels [...]`` int -> per-position NLL ``[...]`` f32,
+    without ever materializing ``[..., v]`` logits in HBM.
+    Differentiable in x and w (custom VJP).  ``interpret=None``
+    auto-selects Pallas interpreter mode off-TPU (CPU tests)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    n = 1
+    for s in lead:
+        n *= int(s)
+    loss = _ce_core(
+        x.reshape(n, d), w, labels.reshape(n).astype(jnp.int32),
+        (int(block_n), int(block_v)), bool(interpret))
+    return loss.reshape(lead)
+
+
+def fused_softmax_ce_head_reference(x, w, labels):
+    """Dense reference (tests / tiny shapes): materializes logits."""
+    logits = jnp.einsum("...d,dv->...v", x, w,
+                        preferred_element_type=jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    lbl = labels.astype(jnp.int32)
+    return -jnp.take_along_axis(logp, lbl[..., None], axis=-1)[..., 0]
+
+
+@register_op("fused_softmax_ce_head")
+def fused_softmax_ce_head_op(X, W, Label, block_n=512, block_v=1024, **_):
+    lbl = Label
+    if lbl.ndim == X.ndim and lbl.shape[-1] == 1:
+        lbl = lbl.reshape(lbl.shape[:-1])
+    loss = fused_softmax_ce_head(X, W, lbl, block_n=block_n,
+                                 block_v=block_v)
+    return {"Loss": loss[..., None]}
