@@ -148,6 +148,40 @@ def bench_gpt(n_chips, mesh_factory, steps, warmup):
     return tokens_per_s / n_chips, mfu
 
 
+def flash_numeric_gate():
+    """On-chip flash-vs-dense max-relative-error check (f32-highest
+    matmuls so the comparison is meaningful on TPU).  Runs a few shapes
+    including the flagship's t=4096/d=128 block geometry; a masking/
+    block-index regression would surface here as a big error instead of
+    shipping as a slightly-wrong training loss.  Returns the max rel
+    err over all shapes (driver records it in BENCH json)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_attention import (
+        attention_reference, flash_attention)
+
+    worst = 0.0
+    with jax.default_matmul_precision("highest"):
+        for (b, t, h, d, bq, bk, causal) in [
+            (1, 512, 2, 64, 128, 128, True),
+            (1, 512, 2, 64, 128, 256, False),
+            (2, 4096, 2, 128, 1024, 1024, True),  # flagship geometry
+        ]:
+            rng = np.random.default_rng(17)
+            q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5,
+                                   jnp.float32) for _ in range(3))
+            o = flash_attention(q, k, v, causal=causal, block_q=bq,
+                                block_k=bk)
+            ref = attention_reference(q, k, v, causal=causal)
+            scale = float(jnp.max(jnp.abs(ref))) or 1.0
+            err = float(jnp.max(jnp.abs(o - ref))) / scale
+            worst = max(worst, err)
+            assert err < 2e-3, (
+                f"flash numeric gate FAILED: rel err {err:.2e} at "
+                f"t={t} d={d} causal={causal} blocks=({bq},{bk})")
+    return worst
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
@@ -180,6 +214,9 @@ def main():
         tok_per_chip, mfu = bench_gpt(n_chips, mesh_factory, steps, warmup)
         extra["gpt_tokens_per_sec_per_chip"] = round(tok_per_chip, 1)
         extra["gpt_mfu"] = round(mfu, 4)
+    if os.environ.get("BENCH_FLASH_GATE", "1").lower() not in (
+            "0", "", "false"):
+        extra["flash_max_rel_err"] = round(flash_numeric_gate(), 7)
 
     if img_per_chip is None:  # gpt-only run (BENCH_MODELS=gpt)
         print(json.dumps({
@@ -187,6 +224,8 @@ def main():
             "value": extra["gpt_tokens_per_sec_per_chip"],
             "unit": "tok/s/chip",
             "vs_baseline": extra["gpt_mfu"],
+            "extra": {k: v for k, v in extra.items()
+                      if k.startswith("flash")},
         }))
         return
     target_per_chip = 3000.0 / 16.0

@@ -5,13 +5,13 @@ config the flagship trains with.  Also measures the pack/unpack
 [b, t, h, d] API."""
 
 import glob
-import json
+import os
 import sys
 import tempfile
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

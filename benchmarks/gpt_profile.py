@@ -22,7 +22,7 @@ import numpy as np
 
 
 def hlo_self_times(pb_path):
-    """[(category, hlo_op_name, program_id, total_self_us, occurrences)]"""
+    """[(category, hlo_op_name, total_self_us, occurrences)]"""
     from xprof.convert import raw_to_tool_data as r2t
 
     data, _ = r2t.xspace_to_tool_data([pb_path], "hlo_stats", {})

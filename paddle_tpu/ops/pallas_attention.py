@@ -17,8 +17,8 @@ blocks straddling the diagonal.  Backward: custom_vjp into two Pallas
 kernels — dq (q-major grid) and dk/dv (k-major grid) — recomputing p from
 the saved lane-replicated lse, also with causal block skip.
 delta = rowsum(do*o) is computed inside the kernels.  HBM residuals are
-O(t) rows (lse carries 128 f32 lanes/row, the same layout the public TPU
-flash/splash kernels use); VMEM stays O(block^2).
+O(t) rows (lse is stored 2-D [bh, t] — 4 B/row; the in-kernel softmax
+state uses 128-lane scratch tiles); VMEM stays O(block^2).
 
 MXU feeds stay in the input dtype: bf16 q/k/v/do go straight into the
 dots with f32 accumulation (bf16 input is 2x the f32 MXU rate on v5e);
@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 NEG_INF = -1e30
-LSE_LANES = 128  # Mosaic min lane tile; lse vectors are lane-replicated
+LSE_LANES = 128  # Mosaic min lane tile (in-kernel m/l scratch width);
+# lse ITSELF is stored narrow: [bq, 1] kernel outputs, 2-D [bh, t] residuals
 
 
 def _pick_block(t, cap):
@@ -215,7 +216,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, nk,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]      # [bq, LSE_LANES] lane-replicated
+        lse = lse_ref[0]      # [bq, 1] narrow residual block
         delta = delta_scr[...]
         bq = q.shape[0]
         s = jax.lax.dot_general(
