@@ -182,10 +182,21 @@ class GeneratedInput(BaseGeneratedInput):
 
 
 class BeamInput:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "cross_entropy_over_beam training is not carried; use "
-            "layers.softmax_with_cross_entropy over decoded beams")
+    """One beam expansion for cross_entropy_over_beam (reference
+    ``trainer_config_helpers/layers.py:6362``): the candidate scores of
+    every surviving prefix, the selected top-k candidate ids (-1
+    padded; e.g. ``kmax_seq_score_layer`` output), and the gold id."""
+
+    def __init__(self, candidate_scores=None, selected_candidates=None,
+                 gold=None, **_):
+        if candidate_scores is None or selected_candidates is None \
+                or gold is None:
+            raise ValueError(
+                "BeamInput needs candidate_scores, selected_candidates "
+                "and gold")
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
 
 
 # ------------------------------------------------------------- projections
@@ -417,10 +428,26 @@ def memory(name=None, size=None, boot_layer=None, is_seq=False, **_):
 def recurrent_group(step, input, reverse=False, name=None, **_):
     """Run `step` over each timestep of the sequence inputs (reference
     layers.py recurrent_group -> the scan_block op).  StaticInput wrappers
-    pass through unsliced; memories link to same-named step layers."""
+    pass through unsliced; memories link to same-named step layers.
+
+    ``reverse=True`` (reference ``layers.py:347``): the step visits the
+    sequence last-to-first and the outputs come back aligned with the
+    INPUT order.  Implemented as length-aware rotation — reverse each
+    sequence input (padding stays right-aligned so the group's
+    padded-steps-don't-advance-memories masking is untouched), scan
+    forward, reverse the outputs back."""
     from ..layers import control_flow as cf
 
     ins = input if isinstance(input, (list, tuple)) else [input]
+    if reverse:
+        def _rev(i):
+            if isinstance(i, StaticInput):
+                return i
+            if isinstance(i, SubsequenceInput):
+                return SubsequenceInput(layers.sequence_reverse(i.input))
+            return layers.sequence_reverse(i)
+
+        ins = [_rev(i) for i in ins]
     seq_ins = [i for i in ins if not isinstance(i, StaticInput)]
     if not seq_ins:
         raise ValueError("recurrent_group needs at least one sequence input")
@@ -467,11 +494,6 @@ def recurrent_group(step, input, reverse=False, name=None, **_):
                 rnn.step_output(o)
     finally:
         _RNN_STACK.pop()
-    if reverse:
-        raise NotImplementedError(
-            "reverse recurrent_group: use layers.dynamic_lstm/gru "
-            "(is_reverse=True) or reverse the sequence with "
-            "layers.sequence ops before/after the group")
     result = rnn()
     if getattr(first, "lod_level", 0) > 0:
         # outputs are sequences over the scanned input's lengths (outer
@@ -481,6 +503,12 @@ def recurrent_group(step, input, reverse=False, name=None, **_):
         for o in (result if isinstance(result, list) else [result]):
             o.lod_level = 1
             o.block.vars[o.name + "@LENGTH"] = out_len
+    if reverse:
+        # un-rotate so output position t corresponds to input position t
+        if isinstance(result, list):
+            result = [layers.sequence_reverse(o) for o in result]
+        else:
+            result = layers.sequence_reverse(result)
     return result
 
 
@@ -1189,10 +1217,37 @@ def sub_nested_seq_layer(input, selected_indices, name=None, **_):
     return out
 
 
-def cross_entropy_over_beam(input, **_):
-    raise NotImplementedError(
-        "beam-level cross entropy training is not carried; train with "
-        "softmax_with_cross_entropy and decode with layers.beam_search")
+def cross_entropy_over_beam(input, name=None, **_):
+    """Learning-to-search cost over beam expansions (reference
+    ``gserver/layers/CrossEntropyOverBeam.cpp``, DSL
+    ``trainer_config_helpers/layers.py:6386``): softmax over the summed
+    scores of every complete candidate path through the expansions, NLL
+    of the gold path; when the gold falls off the beam at step t the
+    cost is over the beam at t with the gold appended as an extra path.
+    Lowers to the native ``cross_entropy_over_beam`` op
+    (``ops/beam_ce_ops.py``), which is the static-shape/jittable
+    re-design of the reference's CPU-only per-sequence path loops."""
+    if isinstance(input, BeamInput):
+        input = [input]
+    for ipt in input:
+        if not isinstance(ipt, BeamInput):
+            raise TypeError(
+                "cross_entropy_over_beam input must be BeamInput objects")
+    helper = LayerHelper("cross_entropy_over_beam", name=name)
+    scores = [b.candidate_scores for b in input]
+    ids = [b.selected_candidates for b in input]
+    gold = [b.gold for b in input]
+    batch = scores[0].shape[0]
+    out = helper.create_tmp_variable("float32", [batch, 1])
+    helper.append_op(
+        type="cross_entropy_over_beam",
+        inputs={"Scores": [s.name for s in scores],
+                "Ids": [i.name for i in ids],
+                "Gold": [g.name for g in gold]},
+        outputs={"Out": [out.name]},
+    )
+    _register_name(out, name)
+    return out
 
 
 # ----------------------------------------------------- activations / attrs
@@ -1291,10 +1346,42 @@ def ModelAverage(average_window, max_average_window=None,
 
 
 # -------------------------------------------------------------- evaluators
-def evaluator_base(*a, **k):
-    """Reference evaluators attach to the config proto; here each maps to
-    an in-program metric layer or a host-side evaluator class."""
-    raise NotImplementedError("use the specific *_evaluator constructors")
+def evaluator_base(input, type, label=None, weight=None, name=None,
+                   top_k=None, chunk_scheme=None, num_chunk_types=None,
+                   excluded_chunk_types=None, positive_label=None,
+                   query_id=None, **_):
+    """Generic evaluator dispatcher (reference
+    ``trainer_config_helpers/evaluators.py:71``: every ``*_evaluator``
+    funnels into evaluator_base with a ``type`` string).  Maps the type
+    to the corresponding in-program metric layer; reference evaluators
+    attached to the config proto, here they are ordinary fetchable
+    metric variables."""
+    t = str(type)
+    if t in ("classification_error", "classification_error_printer"):
+        return layers.accuracy(input=input, label=label,
+                               k=top_k or 1)
+    if t == "auc":
+        return layers.auc(input=input, label=label)
+    if t in ("chunk", "chunk_evaluator"):
+        return layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme or "IOB",
+            num_chunk_types=num_chunk_types or 1,
+            excluded_chunk_types=excluded_chunk_types)
+    if t in ("precision_recall", "precision_recall_evaluator"):
+        return precision_recall_evaluator(input, label,
+                                          positive_label=positive_label)
+    if t in ("pnpair", "pnpair_evaluator"):
+        if query_id is None:
+            raise ValueError("pnpair evaluator needs query_id")
+        return pnpair_evaluator(input, label, query_id, weight=weight)
+    if t in ("sum", "sum_evaluator"):
+        return sum_evaluator(input)
+    if t in ("column_sum", "column_sum_evaluator", "last-column-sum"):
+        return column_sum_evaluator(input)
+    # printer family: evaluation-time inspection — fetch the value itself
+    if t.endswith("_printer") or t in ("value_printer", "seq_text_printer"):
+        return input
+    raise ValueError(f"unknown evaluator type {type!r}")
 
 
 def classification_error_evaluator(input, label, **_):

@@ -39,6 +39,7 @@ __all__ = [
     "sequence_expand",
     "sequence_reshape",
     "sequence_softmax",
+    "sequence_reverse",
     "softmax",
     "softmax_with_cross_entropy",
     "fused_softmax_ce_head",
@@ -1485,6 +1486,34 @@ def spp(input, pyramid_height=3, pool_type="max", name=None):
         type="spp", inputs={"X": [input.name]}, outputs={"Out": [out.name]},
         attrs={"pyramid_height": pyramid_height, "pooling_type": pool_type},
     )
+    return out
+
+
+def sequence_reverse(x, name=None):
+    """Length-aware reversal along the (outer) time axis: element t of
+    each sequence swaps with element len-1-t; padding stays in place.
+    For a nested (lod 2) input the OUTER subsequence order is reversed
+    and the @SUBLENGTH shadow is permuted to match.  The v1
+    ``recurrent_group(reverse=True)`` support (reference
+    ``trainer_config_helpers/layers.py:347``)."""
+    helper = LayerHelper("sequence_reverse", name=name)
+    inputs = {"X": [x.name]}
+    ln = seq_length(x)
+    if ln is not None:
+        inputs["Length"] = [ln.name]
+    out = helper.create_tmp_variable(x.dtype, list(x.shape),
+                                     lod_level=x.lod_level)
+    helper.append_op(type="sequence_reverse", inputs=inputs,
+                     outputs={"Out": [out.name]})
+    _link_length(out, x)
+    if getattr(x, "lod_level", 0) >= 2:
+        sub = x.sub_length_var()
+        sub_rev = helper.create_tmp_variable(sub.dtype, list(sub.shape))
+        helper.append_op(
+            type="sequence_reverse",
+            inputs={"X": [sub.name], "Length": [x.length_var().name]},
+            outputs={"Out": [sub_rev.name]})
+        out.block.vars[out.name + "@SUBLENGTH"] = sub_rev
     return out
 
 

@@ -20,6 +20,7 @@ from . import rnn_ops
 from . import optimizer_ops
 from . import control_flow_ops
 from . import beam_search_ops
+from . import beam_ce_ops
 from . import metric_ops
 from . import detection_ops
 from . import ctc_ops

@@ -1,0 +1,156 @@
+"""Beam-level training: cross_entropy_over_beam.
+
+Reference: ``paddle/gserver/layers/CrossEntropyOverBeam.{h,cpp}`` (DSL
+surface ``trainer_config_helpers/layers.py cross_entropy_over_beam`` /
+``BeamInput``) — the learning-to-search cost.  Per sequence, a beam
+search produced E expansions; expansion ``e`` holds the candidate
+scores of every surviving prefix (rows), the top-k candidate ids
+selected per row (-1 padded), and the gold candidate id.  The cost
+enumerates every complete candidate path through the expansions, sums
+the selected scores along each path, softmaxes over all paths, and
+takes the NLL of the gold path; if the gold falls off the beam at step
+t, the cost is computed over the beam at step t with the gold path
+appended as an extra candidate (``CrossEntropyOverBeam.cpp:19-163``).
+
+The reference constructs paths with per-sequence dynamic loops on CPU
+("the process of constructing beams is not friendly to GPU" —
+CrossEntropyOverBeam.h:110).  The TPU version is static-shape: each
+expansion is dense-padded (scores [b, R, L], ids [b, R, B], gold [b]);
+rows of expansion e+1 correspond to the valid (non -1) candidates of
+expansion e in row-major order; the fall-off step is data-dependent,
+handled by ``lax.switch`` over the E possible stopping points; the path
+table is the full R*B slot grid (+1 gold-extra slot) with invalid slots
+masked to -inf inside the softmax.  Everything is gathers and masks, so
+the whole cost is differentiable and jits."""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _one_sample_loss(scores, ids, gold):
+    """Loss for ONE sequence.  scores[e] [R_e, L_e] f32, ids[e] [R_e, B_e]
+    int32 (-1 padded), gold[e] scalar int32.  Returns scalar f32."""
+    E = len(ids)
+
+    # --- track the gold prefix through the expansions -------------------
+    # goldRow[e]: which row of expansion e the gold prefix sits in (= the
+    # rank of the gold candidate among the valid candidates of e-1);
+    # goldCol[e]: the gold id's position within that row's beam, -1 when
+    # the gold fell off (reference calValidExpandStep).
+    gold_rows, gold_cols = [], []
+    gold_row = jnp.int32(0)
+    all_found = jnp.bool_(True)
+    valid_cnt = jnp.int32(0)
+    for e in range(E):
+        if e:
+            prev = ids[e - 1].reshape(-1)
+            upto = (gold_rows[e - 1] * ids[e - 1].shape[1]
+                    + gold_cols[e - 1])
+            pos = jnp.arange(prev.shape[0])
+            gold_row = jnp.sum(
+                ((prev != -1) & (pos < upto)).astype(jnp.int32))
+        row_vals = ids[e][jnp.clip(gold_row, 0, ids[e].shape[0] - 1)]
+        match = row_vals == gold[e]
+        found = jnp.any(match)
+        gold_col = jnp.where(found, jnp.argmax(match).astype(jnp.int32),
+                             jnp.int32(-1))
+        gold_rows.append(gold_row)
+        gold_cols.append(gold_col)
+        # the step where the gold falls off still counts as a valid
+        # expansion (the cost is computed over the beam at that step)
+        valid_cnt = jnp.where(all_found, jnp.int32(e + 1), valid_cnt)
+        all_found = jnp.logical_and(all_found, found)
+
+    gold_rows = jnp.stack(gold_rows)
+    gold_cols = jnp.stack(gold_cols)
+
+    def make_branch(t):
+        # cost over the beam at (static) expansion t
+        def branch(_):
+            R, B = ids[t].shape
+            P = R * B
+            flat = ids[t].reshape(-1)
+            validm = flat != -1
+            rowidx = jnp.arange(P) // B
+            Lt = scores[t].shape[1]
+            path_score = scores[t][rowidx, jnp.clip(flat, 0, Lt - 1)]
+            path_score = jnp.where(validm, path_score, 0.0)
+            parent = rowidx
+            # backtrack: row r of expansion e+1 is the r-th valid
+            # candidate of expansion e (row-major)
+            for e in range(t - 1, -1, -1):
+                Re, Be = ids[e].shape
+                flat_e = ids[e].reshape(-1)
+                vm_e = flat_e != -1
+                rank = jnp.cumsum(vm_e.astype(jnp.int32)) - 1
+                rows_next = ids[e + 1].shape[0]
+                origin = jnp.zeros((rows_next,), jnp.int32)
+                origin = origin.at[
+                    jnp.where(vm_e, rank, rows_next)
+                ].set(jnp.arange(Re * Be, dtype=jnp.int32), mode="drop")
+                slot = origin[jnp.clip(parent, 0, rows_next - 1)]
+                row_e = slot // Be
+                id_e = flat_e[slot]
+                Le = scores[e].shape[1]
+                path_score = path_score + jnp.where(
+                    validm,
+                    scores[e][row_e, jnp.clip(id_e, 0, Le - 1)], 0.0)
+                parent = row_e
+
+            # the gold path: one of the real slots when it survived, an
+            # appended extra path when it fell off at step t
+            fell = gold_cols[t] == -1
+            gold_extra = jnp.float32(0.0)
+            for e in range(t + 1):
+                Le = scores[e].shape[1]
+                gold_extra = gold_extra + scores[e][
+                    jnp.clip(gold_rows[e], 0, scores[e].shape[0] - 1),
+                    jnp.clip(gold[e], 0, Le - 1)]
+            total = jnp.where(validm, path_score, -jnp.inf)
+            total = jnp.concatenate(
+                [total, jnp.where(fell, gold_extra, -jnp.inf)[None]])
+            gold_pos = jnp.where(
+                fell, jnp.int32(P), gold_rows[t] * B + gold_cols[t])
+            return (jax.scipy.special.logsumexp(total)
+                    - total[gold_pos]).astype(jnp.float32)
+
+        return branch
+
+    t_idx = jnp.clip(valid_cnt - 1, 0, E - 1)
+    return jax.lax.switch(t_idx, [make_branch(t) for t in range(E)],
+                          jnp.float32(0.0))
+
+
+def cross_entropy_over_beam_fn(scores, ids, gold):
+    """Batched beam cross entropy.  scores: list of E arrays [b, R_e, L_e];
+    ids: list of E int arrays [b, R_e, B_e] (-1 padded); gold: list of E
+    int arrays [b].  Returns [b] f32 losses."""
+    scores = [s.astype(jnp.float32) for s in _as_list(scores)]
+    ids = [(i[:, None, :] if i.ndim == 2 else i).astype(jnp.int32)
+           for i in _as_list(ids)]
+    gold = [g.reshape(g.shape[0]).astype(jnp.int32)
+            for g in _as_list(gold)]
+    return jax.vmap(_one_sample_loss)(scores, ids, gold)
+
+
+@register_op("cross_entropy_over_beam")
+def cross_entropy_over_beam_op(Scores, Ids, Gold, **_):
+    scores = _as_list(Scores)
+    ids3 = []
+    for i in _as_list(Ids):
+        if i.ndim == 2:  # [b, B] single-row expansion
+            i = i[:, None, :]
+        ids3.append(i)
+    scores3 = []
+    for s in scores:
+        if s.ndim == 2:
+            s = s[:, None, :]
+        scores3.append(s)
+    loss = cross_entropy_over_beam_fn(scores3, ids3, _as_list(Gold))
+    return {"Out": loss[:, None]}

@@ -154,6 +154,25 @@ def sequence_slice(X, Offset, SeqLength, **_):
     return {"Out": out, "OutLength": ln}
 
 
+@register_op("sequence_reverse")
+def sequence_reverse(X, Length=None, **_):
+    """Length-aware per-sequence reversal (the v1 ``reverse=`` group
+    support; reference semantics: RecurrentGradientMachine reversed
+    groups, ``trainer_config_helpers/layers.py:347``):
+    ``out[b, t] = x[b, len_b - 1 - t]`` for ``t < len_b``, padding stays
+    in place — so right-padded layouts remain right-padded and masking
+    conventions survive a round trip."""
+    b, t = X.shape[0], X.shape[1]
+    if Length is None:
+        return {"Out": X[:, ::-1]}
+    ln = Length.reshape(-1, 1).astype(jnp.int32)
+    idx = jnp.arange(t)[None, :]
+    ridx = jnp.where(idx < ln, ln - 1 - idx, idx)
+    out = jnp.take_along_axis(
+        X, ridx.reshape((b, t) + (1,) * (X.ndim - 2)), axis=1)
+    return {"Out": out}
+
+
 @register_op("sequence_erase", nondiff=True)
 def sequence_erase(X, Length=None, tokens=(), **_):
     """Remove given token ids, compacting each sequence left

@@ -1,0 +1,234 @@
+"""cross_entropy_over_beam (ops/beam_ce_ops.py + v1 DSL surface) vs an
+independent numpy implementation of the reference algorithm
+(gserver/layers/CrossEntropyOverBeam.cpp: gold tracking, total path
+expansion with parent backtracking, gold-as-extra-path when it falls
+off, softmax over path scores)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.beam_ce_ops import cross_entropy_over_beam_fn
+
+from op_test import run_op
+
+
+def golden_one(scores, ids, gold):
+    """Reference algorithm, plain python loops.  scores[e] [R,L] float;
+    ids[e] [R,B] int (-1 pad); gold[e] int.  Returns scalar loss."""
+    E = len(ids)
+    # gold tracking (calValidExpandStep)
+    gold_rows, gold_cols = [], []
+    valid_cnt = 0
+    for e in range(E):
+        if e == 0:
+            gr = 0
+        else:
+            prev = ids[e - 1].reshape(-1)
+            upto = gold_rows[e - 1] * ids[e - 1].shape[1] + gold_cols[e - 1]
+            gr = int(np.sum(prev[:upto] != -1))
+        row = ids[e][gr]
+        gc = -1
+        for j, v in enumerate(row):
+            if v == gold[e]:
+                gc = j
+                break
+        gold_rows.append(gr)
+        gold_cols.append(gc)
+        valid_cnt += 1
+        if gc == -1:
+            break
+    t = valid_cnt - 1
+    fell = gold_cols[t] == -1
+
+    # enumerate complete paths through expansions 0..t (reference
+    # constructTotalExpansion): each valid slot of expansion t is a path;
+    # row r of expansion e+1 = the r-th valid candidate of expansion e.
+    paths = []  # list of per-path [slot_e for e in 0..t]
+    R, B = ids[t].shape
+    for r in range(R):
+        for j in range(B):
+            if ids[t][r, j] == -1:
+                continue
+            slots = [None] * (t + 1)
+            slots[t] = (r, j)
+            parent = r
+            for e in range(t - 1, -1, -1):
+                flat_e = ids[e].reshape(-1)
+                valid_pos = [q for q in range(flat_e.shape[0])
+                             if flat_e[q] != -1]
+                q = valid_pos[parent]
+                slots[e] = (q // ids[e].shape[1], q % ids[e].shape[1])
+                parent = q // ids[e].shape[1]
+            paths.append(slots)
+    path_scores = []
+    gold_idx = None
+    for p, slots in enumerate(paths):
+        s = 0.0
+        for e, (r, j) in enumerate(slots):
+            s += float(scores[e][r, ids[e][r, j]])
+        path_scores.append(s)
+        if not fell and slots[t] == (gold_rows[t], gold_cols[t]):
+            gold_idx = p
+    if fell:
+        s = sum(float(scores[e][gold_rows[e], gold[e]])
+                for e in range(t + 1))
+        path_scores.append(s)
+        gold_idx = len(path_scores) - 1
+    ps = np.asarray(path_scores, np.float64)
+    m = ps.max()
+    lse = m + np.log(np.exp(ps - m).sum())
+    return lse - ps[gold_idx]
+
+
+def _tracked_case(rng, E, R, B, L, batch, fall_at=None):
+    """Random but CONSISTENT beams: expansion e+1 has exactly one row
+    per valid candidate of expansion e (unused rows all -1), and the
+    gold is chosen along the actual tracked gold row at every step (so
+    multi-step survival is exercised); ``fall_at`` forces the gold off
+    the beam at that step."""
+    scores, ids, gold = [], [], []
+    # per-sample valid-candidate count of the previous expansion
+    n_rows = [1] * batch
+    for e in range(E):
+        rows = 1 if e == 0 else R
+        scores.append(rng.normal(size=(batch, rows, L)).astype(np.float32))
+        iD = np.full((batch, rows, B), -1, np.int64)
+        for b in range(batch):
+            active = min(n_rows[b], rows)
+            # keep V_e <= rows(e+1) while giving every active row >= 1
+            budget = max(R if e + 1 < E else active * B, active)
+            total = 0
+            for r in range(active):
+                remaining = active - r - 1
+                kmax = min(B, budget - total - remaining)
+                k = int(rng.integers(1, kmax + 1))
+                iD[b, r, :k] = rng.choice(L, size=k, replace=False)
+                total += k
+            n_rows[b] = total
+        ids.append(iD)
+        gold.append(np.zeros((batch,), np.int64))
+    for b in range(batch):
+        gr, gc = 0, -1
+        for e in range(E):
+            row = ids[e][b, gr]
+            if fall_at is not None and e == fall_at:
+                g = L - 1
+                while g in row:
+                    g -= 1
+                gold[e][b] = g
+                break
+            valid = row[row != -1]
+            pick = int(valid[rng.integers(0, len(valid))])
+            gold[e][b] = pick
+            gc = int(np.where(row == pick)[0][0])
+            if e + 1 < E:
+                prev_flat = ids[e][b].reshape(-1)
+                upto = gr * ids[e].shape[2] + gc
+                gr = int(np.sum(prev_flat[:upto] != -1))
+    return scores, ids, gold
+
+
+@pytest.mark.parametrize("fall_at", [None, 1, 0])
+def test_beam_ce_matches_golden(fall_at):
+    rng = np.random.default_rng(0 if fall_at is None else 10 + fall_at)
+    E, R, B, L, batch = 3, 4, 3, 6, 5
+    scores, ids, gold = _tracked_case(rng, E, R, B, L, batch,
+                                      fall_at=fall_at)
+    got = np.asarray(cross_entropy_over_beam_fn(
+        [jnp.asarray(s) for s in scores],
+        [jnp.asarray(i) for i in ids],
+        [jnp.asarray(g) for g in gold]))
+    for b in range(batch):
+        ref = golden_one([s[b] for s in scores], [i[b] for i in ids],
+                         [int(g[b]) for g in gold])
+        np.testing.assert_allclose(got[b], ref, rtol=1e-5, atol=1e-5,
+                                   err_msg=f"sample {b} fall_at={fall_at}")
+
+
+def test_beam_ce_single_expansion_equals_softmax_ce():
+    """One expansion, one row: the cost reduces to plain softmax cross
+    entropy over the selected candidates' scores."""
+    rng = np.random.default_rng(3)
+    L, B = 8, 4
+    s = rng.normal(size=(1, 1, L)).astype(np.float32)
+    ids = np.asarray([[[1, 4, 6, 2]]], np.int64)
+    gold = np.asarray([4], np.int64)
+    got = float(np.asarray(cross_entropy_over_beam_fn(
+        [jnp.asarray(s)], [jnp.asarray(ids)], [jnp.asarray(gold)]))[0])
+    sel = s[0, 0, [1, 4, 6, 2]]
+    ref = -np.log(np.exp(sel[1]) / np.exp(sel).sum())
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_beam_ce_gradients_flow_to_scores():
+    """Grad wrt scores == softmax-minus-onehot scattered along paths
+    (checked against numeric finite differences of the golden)."""
+    rng = np.random.default_rng(4)
+    E, R, B, L = 2, 3, 2, 5
+    scores, ids, gold = _tracked_case(rng, E, R, B, L, batch=1)
+
+    def loss_fn(*flat_scores):
+        return cross_entropy_over_beam_fn(
+            list(flat_scores), [jnp.asarray(i) for i in ids],
+            [jnp.asarray(g) for g in gold])[0]
+
+    grads = jax.grad(loss_fn, argnums=tuple(range(E)))(
+        *[jnp.asarray(s) for s in scores])
+    eps = 1e-3
+    for e in range(E):
+        g_num = np.zeros_like(scores[e])
+        for idx in np.ndindex(scores[e].shape):
+            up = scores[e].copy(); up[idx] += eps
+            dn = scores[e].copy(); dn[idx] -= eps
+            su = [s if k != e else up for k, s in enumerate(scores)]
+            sd = [s if k != e else dn for k, s in enumerate(scores)]
+            fu = golden_one([s[0] for s in su], [i[0] for i in ids],
+                            [int(g[0]) for g in gold])
+            fd = golden_one([s[0] for s in sd], [i[0] for i in ids],
+                            [int(g[0]) for g in gold])
+            g_num[idx] = (fu - fd) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(grads[e]), g_num,
+                                   atol=2e-3, err_msg=f"expansion {e}")
+
+
+def test_beam_ce_op_and_v1_layer():
+    """The registered op and the v1 DSL surface produce the golden."""
+    import paddle_tpu as pt
+    from paddle_tpu.compat import v1_ext as v1x
+
+    rng = np.random.default_rng(5)
+    E, R, B, L, batch = 2, 3, 2, 5, 3
+    scores, ids, gold = _tracked_case(rng, E, R, B, L, batch)
+    out = run_op("cross_entropy_over_beam",
+                 {"Scores": scores, "Ids": ids,
+                  "Gold": [g[:, None] for g in gold]})
+    for b in range(batch):
+        ref = golden_one([s[b] for s in scores], [i[b] for i in ids],
+                         [int(g[b]) for g in gold])
+        np.testing.assert_allclose(out["Out"][b, 0], ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    # v1 DSL: BeamInput + cross_entropy_over_beam build a program
+    feeds = {}
+    beam_inputs = []
+    for e in range(E):
+        rows = scores[e].shape[1]
+        sc = pt.layers.data(f"sc{e}", shape=[rows, L], dtype="float32")
+        idv = pt.layers.data(f"id{e}", shape=[rows, B], dtype="int64")
+        gv = pt.layers.data(f"g{e}", shape=[1], dtype="int64")
+        feeds[f"sc{e}"] = scores[e]
+        feeds[f"id{e}"] = ids[e]
+        feeds[f"g{e}"] = gold[e][:, None]
+        beam_inputs.append(v1x.BeamInput(candidate_scores=sc,
+                                         selected_candidates=idv,
+                                         gold=gv))
+    cost = v1x.cross_entropy_over_beam(input=beam_inputs)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (loss,) = exe.run(feed=feeds, fetch_list=[cost])
+    for b in range(batch):
+        ref = golden_one([s[b] for s in scores], [i[b] for i in ids],
+                         [int(g[b]) for g in gold])
+        np.testing.assert_allclose(loss[b, 0], ref, rtol=1e-5, atol=1e-5)
