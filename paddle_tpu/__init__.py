@@ -66,6 +66,8 @@ from . import flags
 from .flags import FLAGS
 from . import memory_optimization_transpiler
 from .memory_optimization_transpiler import memory_optimize, release_memory
+from . import checkgrad
+from .checkgrad import check_gradients
 from . import compat
 from . import image
 from . import net_drawer

@@ -61,6 +61,27 @@ def cmd_train(args):
 
     mod = _load_config(args.config)
     main, startup, outs = _build(mod)
+    if getattr(args, "job", "train") == "checkgrad":
+        # --job=checkgrad (reference TrainerMain.cpp:54 ->
+        # Trainer.cpp:303 checkGradient): finite-difference every
+        # trainable parameter through the whole jitted step on ONE batch
+        with pt.program_guard(main, startup):
+            exe = pt.Executor()
+            exe.run(startup)
+            reader = getattr(mod, "train_reader", None)
+            if reader is None:
+                raise SystemExit("config must define train_reader()")
+            batch = next(iter(pt.reader.batch(reader,
+                                              args.batch_size)()))
+            feeder = pt.DataFeeder(outs["feed"])
+            ok, report = pt.check_gradients(
+                feeder.feed(batch), outs["avg_cost"], program=main,
+                verbose=True)
+        for name, r in sorted(report.items()):
+            print(f"{name}: max_rel_err={r['max_rel_err']:.3e} "
+                  f"(checked {r['checked']} elements)")
+        print("checkgrad " + ("PASSED" if ok else "FAILED"))
+        return 0 if ok else 1
     with pt.program_guard(main, startup):
         trainer = pt.trainer.Trainer(
             outs["avg_cost"], outs["feed"],
@@ -188,6 +209,10 @@ def main(argv=None):
     sub = p.add_subparsers(dest="command", required=True)
 
     sp = sub.add_parser("train", help="train a model-config script")
+    sp.add_argument("--job", choices=["train", "checkgrad"],
+                    default="train",
+                    help="checkgrad: finite-difference the whole model's "
+                         "gradients on one batch instead of training")
     sp.add_argument("config")
     sp.add_argument("--batch-size", type=int, default=64)
     sp.add_argument("--num-passes", type=int, default=1)

@@ -461,7 +461,11 @@ class ParameterServer:
             cur = (self._epoch, self._versions.get(name, 0))
             if known is not None and tuple(known) == cur:
                 return cur, None
-            return cur, self.params[name]
+            # copy: _call's in-process isolation only covers the bare
+            # "get_param" method name; this value is tuple-nested and a
+            # concurrent step_rows would otherwise mutate it under the
+            # caller (RPC paths get isolation from pickle for free)
+            return cur, np.array(self.params[name])
 
     def get_param_rows(self, name, rows):
         """Sparse fetch (GET_PARAM_SPARSE): only requested rows."""
@@ -874,6 +878,7 @@ class PServerClient:
         nbytes = 0
         fills = []  # unchanged blocks of names that DID change elsewhere
         parts = {}
+        new_versions = {}
         for name in names:
             plan = metas[name]
             blocks = []
@@ -881,7 +886,7 @@ class PServerClient:
             for bi in range(len(plan)):
                 key = self._block_key(name, plan, bi)
                 ver, val = got[key]
-                self._block_versions[key] = ver
+                new_versions[key] = ver
                 if val is not None:
                     changed = True
                     nbytes += np.asarray(val).nbytes
@@ -907,6 +912,11 @@ class PServerClient:
                 vals.append(val)
             out[name] = (vals[0] if len(vals) == 1
                          else np.concatenate(vals, axis=0))
+        # commit the observed versions only now, with every value safely
+        # in hand: recording them before the fill fetch would turn a
+        # transport failure into a permanently-stale client (the retry
+        # would be told "unchanged" for an update it never received)
+        self._block_versions.update(new_versions)
         self.last_delta_bytes = nbytes
         return out
 

@@ -74,6 +74,10 @@ def _load():
     lib.loader_next.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.loader_next.argtypes = [ctypes.c_void_p,
                                 ctypes.POINTER(ctypes.c_uint64)]
+    lib.loader_next_batch.restype = ctypes.c_int64
+    lib.loader_next_batch.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_uint64, ctypes.c_uint64,
+                                      ctypes.c_void_p, ctypes.c_void_p]
     lib.loader_error.restype = ctypes.c_char_p
     lib.loader_error.argtypes = [ctypes.c_void_p]
     lib.loader_destroy.argtypes = [ctypes.c_void_p]
@@ -136,6 +140,46 @@ class Loader:
                     raise IOError(f"loader: {err.decode()}")
                 return
             yield ctypes.string_at(p, n.value)
+
+    def next_batch(self, batch_size, prefix_bytes, payload_bytes,
+                   prefix_dtype="uint8", payload_dtype="uint8"):
+        """Assemble up to ``batch_size`` fixed-size records C-side (the
+        batch-assembly mode): every record must be exactly
+        ``prefix_bytes + payload_bytes``; prefixes (labels) and payloads
+        (tensors) are memcpy'd contiguously into fresh numpy buffers —
+        no per-record Python work.  Returns ``(prefix, payload)`` arrays
+        of ``n`` rows (n < batch_size at end of stream), or ``None``
+        when exhausted.  Raises on malformed records or IO errors."""
+        import numpy as np
+
+        prefix = np.empty((batch_size, prefix_bytes), np.uint8)
+        payload = np.empty((batch_size, payload_bytes), np.uint8)
+        n = self._lib.loader_next_batch(
+            self._h, batch_size, prefix_bytes, payload_bytes,
+            prefix.ctypes.data_as(ctypes.c_void_p),
+            payload.ctypes.data_as(ctypes.c_void_p))
+        if n < 0:
+            err = self._lib.loader_error(self._h)
+            raise IOError(f"loader batch: {err.decode() if err else '?'}")
+        if n == 0:
+            err = self._lib.loader_error(self._h)
+            if err:
+                raise IOError(f"loader: {err.decode()}")
+            return None
+        if n < batch_size:
+            # partial batch = end of stream OR a worker died mid-stream;
+            # surface the error now rather than on a next call the
+            # caller may never make
+            err = self._lib.loader_error(self._h)
+            if err:
+                raise IOError(f"loader: {err.decode()}")
+        pre = prefix[:n]
+        pay = payload[:n]
+        if prefix_dtype != "uint8":
+            pre = pre.view(prefix_dtype)
+        if payload_dtype != "uint8":
+            pay = pay.view(payload_dtype)
+        return pre, pay
 
     def close(self):
         if self._h:

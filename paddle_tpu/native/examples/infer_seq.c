@@ -1,0 +1,74 @@
+/* Serve an NLP (word-id input) model through the C API — the reference
+ * capi/examples pattern for sequence models (paddle_ivector inputs,
+ * capi/vector.h): feed int64 token ids with pt_engine_run_all_typed,
+ * read back float32 outputs per fetch target.
+ *
+ * Usage: infer_seq <model_dir> <pythonpath> <t> id0 id1 ... id{t-1}
+ * Prints each output as "out<i>: v0 v1 ..." one line per fetch target.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <model_dir> <pythonpath> <t> ids...\n",
+            argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  const char* pythonpath = argv[2];
+  int64_t t = atoll(argv[3]);
+  if (argc != 4 + (int)t) {
+    fprintf(stderr, "expected %lld ids\n", (long long)t);
+    return 2;
+  }
+  int64_t* ids = malloc(sizeof(int64_t) * t);
+  for (int64_t j = 0; j < t; j++) ids[j] = atoll(argv[4 + j]);
+
+  if (pt_init(pythonpath) != 0) {
+    fprintf(stderr, "pt_init failed: %s\n", pt_last_error());
+    return 1;
+  }
+  void* h = pt_engine_create(model_dir);
+  if (!h) {
+    fprintf(stderr, "pt_engine_create failed: %s\n", pt_last_error());
+    return 1;
+  }
+
+  /* one int64 sequence input, batch of 1: [1, t] */
+  const char* names[1];
+  names[0] = pt_engine_input_name(h, 0);
+  const void* datas[1] = {ids};
+  const char* dtypes[1] = {"int64"};
+  int64_t shape0[2];
+  shape0[0] = 1;
+  shape0[1] = t;
+  const int64_t* shapes[1] = {shape0};
+  int32_t ranks[1] = {2};
+  if (pt_engine_run_all_typed(h, names, datas, dtypes, shapes, ranks, 1)
+      != 0) {
+    fprintf(stderr, "run failed: %s\n", pt_last_error());
+    return 1;
+  }
+  int32_t n_out = pt_engine_num_outputs(h);
+  for (int32_t i = 0; i < n_out; i++) {
+    const float* out;
+    const int64_t* oshape;
+    int32_t orank;
+    if (pt_engine_output(h, i, &out, &oshape, &orank) != 0) {
+      fprintf(stderr, "output %d failed: %s\n", i, pt_last_error());
+      return 1;
+    }
+    int64_t numel = 1;
+    for (int32_t d = 0; d < orank; d++) numel *= oshape[d];
+    printf("out%d:", i);
+    for (int64_t j = 0; j < numel; j++) printf(" %.6f", out[j]);
+    printf("\n");
+  }
+  pt_engine_destroy(h);
+  pt_shutdown();
+  free(ids);
+  return 0;
+}

@@ -53,6 +53,15 @@ int pt_engine_run_all(void* handle, const char** names, const float** datas,
                       const int64_t** shapes, const int32_t* ranks,
                       int32_t n_inputs);
 
+/* Dtype-tagged variant: dtypes[i] names input i's element type —
+ * "float32", "float64", "int64" or "int32" (NULL entry = float32).
+ * The int paths are how word-id / sequence models are fed (the
+ * reference paddle_ivector, capi/vector.h + sequence Arguments). */
+int pt_engine_run_all_typed(void* handle, const char** names,
+                            const void** datas, const char** dtypes,
+                            const int64_t** shapes, const int32_t* ranks,
+                            int32_t n_inputs);
+
 /* Read cached fetch target ``i`` of the last run.  Output pointers are
  * owned by the handle and valid until the next run/destroy. */
 int pt_engine_output(void* handle, int32_t i, const float** out_data,

@@ -282,11 +282,16 @@ int pt_engine_output(void* handle, int32_t i, const float** out_data,
 }
 
 // Run inference, caching EVERY fetch target (read them back with
-// pt_engine_output).  names[i]: feed name; datas[i]: float32 buffer;
-// shapes[i]: dims (ranks[i] entries).  Returns 0 on success.
-int pt_engine_run_all(void* handle, const char** names, const float** datas,
-                      const int64_t** shapes, const int32_t* ranks,
-                      int32_t n_inputs) {
+// Shared run core for pt_engine_run_all{,_typed}: build the feed dict,
+// call InferenceEngine.run, cache EVERY fetch target on the handle
+// (read back per index with pt_engine_output).  dtypes may be null (all float32) or name each
+// input's element type: "float32" (default), "float64", "int64",
+// "int32" — the int paths are the reference `paddle_ivector` analog
+// (capi/vector.h:30), how word-id / sequence models are served.
+static int run_all_impl(void* handle, const char** names,
+                        const void** datas, const char** dtypes,
+                        const int64_t** shapes, const int32_t* ranks,
+                        int32_t n_inputs) {
   auto* eng = static_cast<Engine*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = -1;
@@ -309,18 +314,43 @@ int pt_engine_run_all(void* handle, const char** names, const float** datas,
     for (int32_t i = 0; i < n_inputs && feed_ok; i++) {
       int64_t numel = 1;
       for (int32_t d = 0; d < ranks[i]; d++) numel *= shapes[i][d];
+      const char* dt = (dtypes && dtypes[i]) ? dtypes[i] : "float32";
       // build a flat python list then reshape via numpy (avoids needing
       // the numpy C API headers)
       PyObject* lst = PyList_New(numel);
       if (!lst) { feed_ok = false; break; }
-      for (int64_t j = 0; j < numel; j++) {
-        PyList_SET_ITEM(lst, j, PyFloat_FromDouble(datas[i][j]));
+      if (std::strcmp(dt, "int64") == 0) {
+        const int64_t* p = static_cast<const int64_t*>(datas[i]);
+        for (int64_t j = 0; j < numel; j++)
+          PyList_SET_ITEM(lst, j, PyLong_FromLongLong(p[j]));
+      } else if (std::strcmp(dt, "int32") == 0) {
+        const int32_t* p = static_cast<const int32_t*>(datas[i]);
+        for (int64_t j = 0; j < numel; j++)
+          PyList_SET_ITEM(lst, j, PyLong_FromLong(p[j]));
+      } else if (std::strcmp(dt, "float64") == 0) {
+        const double* p = static_cast<const double*>(datas[i]);
+        for (int64_t j = 0; j < numel; j++)
+          PyList_SET_ITEM(lst, j, PyFloat_FromDouble(p[j]));
+      } else if (std::strcmp(dt, "float32") == 0) {
+        const float* p = static_cast<const float*>(datas[i]);
+        for (int64_t j = 0; j < numel; j++)
+          PyList_SET_ITEM(lst, j, PyFloat_FromDouble(p[j]));
+      } else {
+        {
+          std::lock_guard<std::mutex> lock(g_mu);
+          g_error = std::string("unsupported input dtype: ") + dt;
+        }
+        Py_DECREF(lst);
+        Py_XDECREF(feed);
+        Py_XDECREF(np);
+        PyGILState_Release(gil);
+        return -1;
       }
       PyObject* shape = PyTuple_New(ranks[i]);
       for (int32_t d = 0; d < ranks[i]; d++) {
         PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(shapes[i][d]));
       }
-      PyObject* arr = PyObject_CallMethod(np, "asarray", "Os", lst, "float32");
+      PyObject* arr = PyObject_CallMethod(np, "asarray", "Os", lst, dt);
       PyObject* reshaped =
           arr ? PyObject_CallMethod(arr, "reshape", "O", shape) : nullptr;
       if (!reshaped) feed_ok = false;
@@ -348,6 +378,28 @@ int pt_engine_run_all(void* handle, const char** names, const float** datas,
   Py_XDECREF(np);
   PyGILState_Release(gil);
   return rc;
+}
+
+// Run inference on float32 inputs, caching every fetch target (read
+// them back with pt_engine_output).  names[i]: feed name; datas[i]:
+// float32 buffer; shapes[i]: dims (ranks[i] entries).  Returns 0 on
+// success.
+int pt_engine_run_all(void* handle, const char** names, const float** datas,
+                      const int64_t** shapes, const int32_t* ranks,
+                      int32_t n_inputs) {
+  return run_all_impl(handle, names,
+                      reinterpret_cast<const void**>(datas), nullptr,
+                      shapes, ranks, n_inputs);
+}
+
+// Dtype-tagged variant: ints for word-id/sequence models (the reference
+// paddle_ivector path, capi/vector.h:30 + arguments.h sequence ids).
+int pt_engine_run_all_typed(void* handle, const char** names,
+                            const void** datas, const char** dtypes,
+                            const int64_t** shapes, const int32_t* ranks,
+                            int32_t n_inputs) {
+  return run_all_impl(handle, names, datas, dtypes, shapes, ranks,
+                      n_inputs);
 }
 
 // Back-compat single-output form: run, then hand back fetch out_index.

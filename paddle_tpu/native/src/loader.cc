@@ -189,6 +189,49 @@ const uint8_t* loader_next(void* handle, uint64_t* len) {
   return L->current.data();
 }
 
+// Batch assembly (the "fold frombuffer+stack into the loader" mode): pop
+// up to `batch` records of EXACTLY prefix_bytes + payload_bytes each and
+// write them contiguously — prefixes (e.g. labels) packed into
+// prefix_out [batch * prefix_bytes], payloads (e.g. image tensors) into
+// payload_out [batch * payload_bytes].  The Python side hands in
+// preallocated numpy buffers, so per-record Python work (frombuffer +
+// stack per element) disappears entirely.  Returns the number of records
+// assembled (0 = stream exhausted), or -1 if a record had the wrong
+// size (stream format mismatch; loader_error() explains).
+int64_t loader_next_batch(void* handle, int64_t batch,
+                          uint64_t prefix_bytes, uint64_t payload_bytes,
+                          uint8_t* prefix_out, uint8_t* payload_out) {
+  auto* L = static_cast<Loader*>(handle);
+  const uint64_t want = prefix_bytes + payload_bytes;
+  int64_t got = 0;
+  while (got < batch) {
+    std::vector<uint8_t> rec;
+    {
+      std::unique_lock<std::mutex> lock(L->q_mu);
+      L->q_pop_cv.wait(
+          lock, [&] { return !L->q.empty() || L->live_workers == 0; });
+      if (L->q.empty()) break;
+      rec = std::move(L->q.front());
+      L->q.pop();
+    }
+    L->q_push_cv.notify_one();
+    if (rec.size() != want) {
+      L->set_error("batch assembly: record of " +
+                   std::to_string(rec.size()) + " bytes, expected " +
+                   std::to_string(want));
+      return -1;
+    }
+    if (prefix_bytes) {
+      std::memcpy(prefix_out + got * prefix_bytes, rec.data(),
+                  prefix_bytes);
+    }
+    std::memcpy(payload_out + got * payload_bytes,
+                rec.data() + prefix_bytes, payload_bytes);
+    got++;
+  }
+  return got;
+}
+
 // Non-empty when any worker hit an IO/decode error; check after exhaustion.
 const char* loader_error(void* handle) {
   auto* L = static_cast<Loader*>(handle);

@@ -76,3 +76,56 @@ def test_capi_end_to_end(tmp_path, capi_lib):
     )
     np.testing.assert_allclose(out0, want, rtol=1e-5)
     np.testing.assert_allclose(out1, 2.0 * want, rtol=1e-5)
+
+
+def test_capi_int_sequence_inputs(tmp_path, capi_lib):
+    """Serve an NLP (word-id) model through the C API: int64 ids in via
+    pt_engine_run_all_typed (the reference paddle_ivector path,
+    capi/vector.h), float32 class distribution out, checked against the
+    in-process InferenceEngine on the same ids."""
+    vocab, emb, t = 20, 8, 5
+    toks = layers.data("tokens", shape=[t], dtype="int64")
+    e = layers.embedding(toks, size=[vocab, emb])
+    pooled = layers.reduce_mean(e, dim=1)
+    pred = layers.fc(input=pooled, size=3, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    model_dir = tmp_path / "seqmodel"
+    pt.io.save_inference_model(str(model_dir), ["tokens"], [pred], exe)
+
+    # reference output from the python engine
+    ids = np.asarray([[3, 7, 1, 19, 0]], np.int64)
+    from paddle_tpu.inference import InferenceEngine
+
+    ref = InferenceEngine(str(model_dir)).run({"tokens": ids})[0]
+
+    exe_path = tmp_path / "infer_seq"
+    include = os.path.join(REPO, "paddle_tpu", "native", "include")
+    src = os.path.join(REPO, "paddle_tpu", "native", "examples",
+                       "infer_seq.c")
+    libdir = os.path.dirname(capi_lib)
+    cc = os.environ.get("CC", "gcc")
+    subprocess.run(
+        [cc, "-O2", src, f"-I{include}", f"-L{libdir}",
+         "-lpaddle_tpu_capi", "-o", str(exe_path),
+         f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True,
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    env["LD_LIBRARY_PATH"] = (
+        libdir + ":" + sysconfig.get_config_var("LIBDIR")
+        + ":" + env.get("LD_LIBRARY_PATH", "")
+    )
+    r = subprocess.run(
+        [str(exe_path), str(model_dir), REPO, str(t),
+         *[str(int(x)) for x in ids.ravel()]],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    line = next(l for l in r.stdout.splitlines() if l.startswith("out0:"))
+    got = np.array([float(v) for v in line.split()[1:]], np.float32)
+    np.testing.assert_allclose(got, np.asarray(ref).ravel(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(got.sum(), 1.0, rtol=1e-4)  # softmax row

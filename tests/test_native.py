@@ -136,3 +136,40 @@ def test_buddy_allocator_coalesce_and_exhaust():
     # after coalescing, one max-size block is allocatable again
     big = b.alloc(1 << 16)
     b.free(big)
+
+
+def test_loader_batch_assembly(tmp_path):
+    """C-side batch assembly (Loader.next_batch): fixed-size records come
+    back as contiguous (prefix, payload) arrays identical to the
+    per-record frombuffer+stack path; malformed sizes raise."""
+    from paddle_tpu.native import Loader, recordio
+
+    payload_bytes, n_rec = 12, 37
+    p = tmp_path / "batch.rio"
+    rng = np.random.default_rng(0)
+    recs = []
+    with recordio.Writer(p, max_chunk_bytes=256) as w:
+        for i in range(n_rec):
+            label = np.asarray([i], "<u2").tobytes()
+            body = rng.integers(0, 256, payload_bytes).astype(np.uint8)
+            recs.append((i, body))
+            w.write(label + body.tobytes())
+
+    got_labels, got_payloads = [], []
+    with Loader(p, num_threads=2) as ld:
+        while True:
+            out = ld.next_batch(8, 2, payload_bytes, prefix_dtype="<u2")
+            if out is None:
+                break
+            lab, pay = out
+            got_labels.extend(int(x) for x in lab.reshape(-1))
+            got_payloads.extend(pay.copy())
+    assert sorted(got_labels) == list(range(n_rec))
+    by_label = {i: b for i, b in recs}
+    for lab, pay in zip(got_labels, got_payloads):
+        np.testing.assert_array_equal(pay, by_label[lab])
+
+    # wrong record size -> clean error, not garbage
+    with Loader(p, num_threads=1) as ld:
+        with pytest.raises(IOError, match="batch assembly"):
+            ld.next_batch(4, 2, payload_bytes + 1)
