@@ -71,8 +71,14 @@ def cmd_train(args):
             reader = getattr(mod, "train_reader", None)
             if reader is None:
                 raise SystemExit("config must define train_reader()")
-            batch = next(iter(pt.reader.batch(reader,
-                                              args.batch_size)()))
+            try:
+                batch = next(iter(pt.reader.batch(reader,
+                                                  args.batch_size)()))
+            except StopIteration:
+                raise SystemExit(
+                    f"train_reader yields fewer than --batch-size "
+                    f"({args.batch_size}) samples; checkgrad needs one "
+                    f"full batch")
             feeder = pt.DataFeeder(outs["feed"])
             ok, report = pt.check_gradients(
                 feeder.feed(batch), outs["avg_cost"], program=main,

@@ -67,9 +67,18 @@ def check_gradients(feed, loss, program=None, scope=None, executor=None,
                          "(call optimizer.minimize first)")
     block.ops = block.ops[:bw]
 
-    names = params or [
+    # the differentiated set comes from the backward info, NOT from all
+    # trainable params: minimize(no_grad_set=...) / parameter_list
+    # exclusions have no <param>@GRAD var to fetch
+    info = prog._backward_info.get(0) or {}
+    diff_params = list(info.get("params", ()))
+    names = params or diff_params or [
         p.name for p in prog.all_parameters() if p.trainable
     ]
+    not_diff = [n for n in names if diff_params and n not in diff_params]
+    if not_diff:
+        raise ValueError(
+            f"params excluded from backward (no @GRAD): {not_diff}")
     missing = [n for n in names if scope.find_var(n) is None]
     if missing:
         raise ValueError(f"params not initialized in scope: {missing}")
@@ -98,33 +107,38 @@ def check_gradients(feed, loss, program=None, scope=None, executor=None,
         k = min(max_elements_per_param, flat.size)
         idx = rng.choice(flat.size, size=k, replace=False)
         worst = 0.0
-        for i in idx:
-            ana = float(analytic[n].reshape(-1)[i])
-            # two step sizes: the larger beats f32 roundoff, the smaller
-            # avoids crossing relu/maxpool kinks (where FD picks up an
-            # O(eps) subgradient-change error); score the better one —
-            # the reference's checker tolerates the same piecewise-linear
-            # noise via its relative-error form
-            rel = np.inf
-            num = 0.0
-            for eps in (epsilon, epsilon / 8):
-                ls = {}
-                for sgn in (1.0, -1.0):
-                    pert = flat.copy()
-                    pert[i] += sgn * eps
-                    scope.set(n,
-                              pert.reshape(base.shape).astype(orig_dtype))
-                    ls[sgn] = float(
-                        np.asarray(run([loss_var])[0]).ravel()[0])
-                scope.set(n, base.astype(orig_dtype))
-                num_e = (ls[1.0] - ls[-1.0]) / (2 * eps)
-                rel_e = abs(num_e - ana) / max(1.0, abs(num_e) + abs(ana))
-                if rel_e < rel:
-                    rel, num = rel_e, num_e
-            worst = max(worst, rel)
-            if verbose:
-                print(f"  {n}[{i}]: numeric={num:.6f} analytic={ana:.6f} "
-                      f"rel={rel:.2e}")
+        try:
+            for i in idx:
+                ana = float(analytic[n].reshape(-1)[i])
+                # two step sizes: the larger beats f32 roundoff, the
+                # smaller avoids crossing relu/maxpool kinks (where FD
+                # picks up an O(eps) subgradient-change error); score the
+                # better one — the reference's checker tolerates the same
+                # piecewise-linear noise via its relative-error form
+                rel = np.inf
+                num = 0.0
+                for eps in (epsilon, epsilon / 8):
+                    ls = {}
+                    for sgn in (1.0, -1.0):
+                        pert = flat.copy()
+                        pert[i] += sgn * eps
+                        scope.set(
+                            n, pert.reshape(base.shape).astype(orig_dtype))
+                        ls[sgn] = float(
+                            np.asarray(run([loss_var])[0]).ravel()[0])
+                    num_e = (ls[1.0] - ls[-1.0]) / (2 * eps)
+                    rel_e = abs(num_e - ana) / max(
+                        1.0, abs(num_e) + abs(ana))
+                    if rel_e < rel:
+                        rel, num = rel_e, num_e
+                worst = max(worst, rel)
+                if verbose:
+                    print(f"  {n}[{i}]: numeric={num:.6f} "
+                          f"analytic={ana:.6f} rel={rel:.2e}")
+        finally:
+            # an aborted evaluation (device error, Ctrl-C) must never
+            # leave a perturbed parameter in the live scope
+            scope.set(n, orig)
         report[n] = {"max_rel_err": worst, "checked": int(k)}
         if worst > rel_tol:
             ok = False

@@ -137,3 +137,25 @@ def test_checkgrad_cli(tmp_path):
     )
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "checkgrad PASSED" in r.stdout, r.stdout
+
+
+def test_checkgrad_respects_no_grad_set():
+    """Params excluded from backward (no @GRAD var) are skipped by
+    default and rejected loudly when requested explicitly."""
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1])
+    h = layers.fc(input=x, size=6, act="tanh", name="frozen")
+    loss = layers.mean(layers.square_error_cost(
+        layers.fc(input=h, size=1, name="head"), y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(
+        loss, no_grad_set={"frozen.w", "frozen.b"})
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(3)
+    feed = {"x": rng.normal(size=(8, 4)).astype(np.float32),
+            "y": rng.normal(size=(8, 1)).astype(np.float32)}
+    ok, report = pt.check_gradients(feed, loss)
+    assert ok
+    assert "frozen.w" not in report and "head.w" in report
+    with pytest.raises(ValueError, match="excluded from backward"):
+        pt.check_gradients(feed, loss, params=["frozen.w"])

@@ -295,3 +295,19 @@ def test_chunk_eval_excluded_chunk_types():
                   "excluded_chunk_types": (1,)})
     assert int(got["NumInferChunks"][0]) == exp[3] == 1
     assert int(got["NumCorrectChunks"][0]) == exp[5] == 1
+
+
+def test_sequence_reverse_op():
+    """Length-aware rotation (sequence_reverse): element t swaps with
+    len-1-t, padding stays right-aligned; no Length = full flip."""
+    x = np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3)
+    lens = np.asarray([2, 4], np.int32)
+    out = run_op("sequence_reverse", {"X": x, "Length": lens})["Out"]
+    ref = x.copy()
+    for b, ln in enumerate(lens):
+        ref[b, :ln] = x[b, :ln][::-1]
+    np.testing.assert_array_equal(out, ref)
+    full = run_op("sequence_reverse", {"X": x})["Out"]
+    np.testing.assert_array_equal(full, x[:, ::-1])
+    check_grad("sequence_reverse", {"X": x, "Length": lens}, "X",
+               max_relative_error=1e-3)
