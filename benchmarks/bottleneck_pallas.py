@@ -17,7 +17,6 @@ Usage: python benchmarks/bottleneck_pallas.py [--interpret]
 """
 
 import argparse
-import functools
 import glob
 import os
 import sys
@@ -206,24 +205,26 @@ def main():
         print("interpret-mode check OK")
         return
 
-    # device-time comparison via the trace (tunnel wall-clock lies)
+    # device-time comparison via SEPARATE traces (tunnel wall-clock
+    # lies, and a shared trace would attribute the fused program's
+    # non-custom-call ops — casts, any layout copies — to the XLA side)
     from benchmarks.gpt_profile import hlo_self_times
 
     steps = 10
-    td = tempfile.mkdtemp(prefix="bneck")
-    with jax.profiler.trace(td):
-        for _ in range(steps):
-            out_f = fused(x, w1, w2, w3, *sb)
-        float(jnp.sum(out_f.astype(jnp.float32).ravel()[0]))
-        for _ in range(steps):
-            out_r = ref(x, w1, w2, w3, *sb)
-        float(jnp.sum(out_r.astype(jnp.float32).ravel()[0]))
-    rows = hlo_self_times(glob.glob(td + "/**/*.xplane.pb",
-                                    recursive=True)[0])
-    fused_us = sum(us for cat, name, us, occ in rows
-                   if cat == "custom-call")
-    xla_us = sum(us for cat, name, us, occ in rows
-                 if cat != "custom-call" and occ >= steps)
+
+    def device_time(fn):
+        td = tempfile.mkdtemp(prefix="bneck")
+        out = None
+        with jax.profiler.trace(td):
+            for _ in range(steps):
+                out = fn(x, w1, w2, w3, *sb)
+            float(jnp.sum(out.astype(jnp.float32).ravel()[0]))
+        rows = hlo_self_times(glob.glob(td + "/**/*.xplane.pb",
+                                        recursive=True)[0])
+        return sum(us for cat, name, us, occ in rows if occ >= steps)
+
+    fused_us = device_time(fused)
+    xla_us = device_time(ref)
     flops = 2 * N * H * W * (Cin * Cm + 9 * Cm * Cm + Cm * Cout)
     print(f"pallas fused: {fused_us/steps/1e3:7.3f} ms "
           f"({flops/(fused_us/steps*1e-6)/1e12:5.1f} TF/s)")

@@ -578,3 +578,44 @@ def test_evaluator_base_dispatch():
     assert acc is not None
     with pytest.raises(ValueError, match="unknown evaluator"):
         v1x.evaluator_base(input=pred, type="nope", label=lbl)
+
+
+def test_recurrent_group_reverse_nested_subsequences():
+    """reverse=True over a SubsequenceInput: the OUTER subsequence order
+    reverses (with @SUBLENGTH permuted to match) and outputs come back
+    aligned to the input order.  Golden: per-sentence sums accumulated
+    in reverse outer order == suffix-sums of per-sentence sums."""
+    b, s, t, d = 2, 3, 4, 3
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(b, s, t, d)).astype(np.float32)
+    SL = np.asarray([[4, 2, 3], [3, 4, 0]], np.int32)  # inner lengths
+    L = np.asarray([3, 2], np.int32)                   # outer counts
+
+    para = pt.layers.data("para", shape=[s, t, d], dtype="float32",
+                          lod_level=2)
+
+    def outer_step(sent):
+        # sent: one subsequence [b, t, d] with its inner lengths
+        omem = v1x.memory(name="acc", size=d)
+        pooled = pt.layers.sequence_pool(sent, "sum")
+        nxt = pt.layers.elementwise_add(omem, pooled)
+        v1x._register_name(nxt, "acc")
+        return nxt
+
+    out = v1x.recurrent_group(outer_step, v1.SubsequenceInput(para),
+                              reverse=True)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (got,) = exe.run(feed={"para": X, "para@LENGTH": L,
+                           "para@SUBLENGTH": SL},
+                     fetch_list=[out])
+    for bb in range(b):
+        sent_sums = [
+            X[bb, j, : SL[bb, j]].sum(axis=0) for j in range(L[bb])
+        ]
+        # reversed outer scan: output slot j = sum of sentence sums j..end
+        for j in range(L[bb]):
+            ref = np.sum(sent_sums[j:], axis=0)
+            np.testing.assert_allclose(got[bb, j], ref, rtol=1e-5,
+                                       atol=1e-5,
+                                       err_msg=f"b={bb} slot={j}")

@@ -258,3 +258,45 @@ def test_memory_optimize_rejects_bad_policy():
     main, _, _ = _mlp_program()
     with _pytest.raises(ValueError, match="policy"):
         memory_optimize(main, policy="selectiv")
+
+
+def test_selective_remat_with_dropout_matches_exactly():
+    """RNG pinning through the custom-VJP remat segments: with dropout
+    ON, selective remat must still be bit-identical to no-remat (the
+    recompute derives the same per-op keys)."""
+    from paddle_tpu.models import transformer
+
+    def build(opt):
+        pt.core.unique_name.reset()
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 21
+        with pt.program_guard(main, startup):
+            outs = transformer.build(vocab_size=30, n_layer=2, n_head=2,
+                                     d_model=32, max_len=12,
+                                     dropout_rate=0.2, dtype="float32")
+        if opt:
+            memory_optimize(main)
+        return main, startup, outs["avg_cost"]
+
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, 30, (4, 12)).astype(np.int64)
+    lbls = np.roll(toks, -1, axis=1)
+
+    def train(main, startup, loss):
+        scope = pt.Scope()
+        pt.core.scope._scope_stack.append(scope)
+        try:
+            exe = pt.Executor()
+            exe.run(startup, scope=scope)
+            return [
+                float(np.asarray(exe.run(
+                    main, feed={"tokens": toks, "labels": lbls},
+                    fetch_list=[loss], scope=scope)[0]).ravel()[0])
+                for _ in range(4)
+            ]
+        finally:
+            pt.core.scope._scope_stack.pop()
+
+    base = train(*build(False))
+    opt = train(*build(True))
+    np.testing.assert_allclose(base, opt, rtol=1e-6)
