@@ -636,7 +636,7 @@ def softmax_with_cross_entropy(logits, label, soft_label=False):
 
 
 def fused_softmax_ce_head(input, label, size, param_attr=None, name=None,
-                          block_n=512, block_v=1024):
+                          block_n=512, block_v=1024, block_v_fwd=2048):
     """Fused LM-head loss: projection [d -> size] + softmax cross-entropy
     in one Pallas kernel that never materializes ``[..., size]`` logits in
     HBM (``ops/pallas_ce.py``).  Replaces the composed
@@ -655,7 +655,8 @@ def fused_softmax_ce_head(input, label, size, param_attr=None, name=None,
         type="fused_softmax_ce_head",
         inputs={"X": [input.name], "W": [w.name], "Label": [label.name]},
         outputs={"Loss": [loss.name]},
-        attrs={"block_n": block_n, "block_v": block_v},
+        attrs={"block_n": block_n, "block_v": block_v,
+               "block_v_fwd": block_v_fwd},
     )
     return loss
 
