@@ -1,0 +1,110 @@
+"""Packed-layout flash attention vs the transpose path — device time.
+
+The round-4 trace charged 23.3 ms/step (8% of device time) to the
+[b,t,h,d]<->[b*h,t,d] pack/unpack transposes around the flash kernels
+(RESULTS.md).  ``flash_attention_packed`` keeps q/k/v in the raw
+projection layout [b, t, h*d] and slices heads in the kernels' block
+index maps instead, so the transposes never exist.  This bench measures
+one attention fwd+bwd at the flagship per-layer shape through both
+paths and reports TOTAL device time (kernels + any layout ops XLA
+inserts), from the xplane trace.
+
+Usage: python benchmarks/packed_flash.py
+"""
+
+import glob
+import json
+import sys
+import tempfile
+
+import numpy as np
+
+
+def hlo_self_times(pb_path):
+    """[(category, hlo_op_name, occurrences, avg_self_us)] rows."""
+    from xprof.convert import raw_to_tool_data as r2t
+
+    data, _ = r2t.xspace_to_tool_data([pb_path], "hlo_stats", {})
+    obj = json.loads(data) if isinstance(data, (str, bytes)) else data
+    cols = [c["id"] for c in obj["cols"]]
+    i_cat = cols.index("category")
+    i_name = cols.index("hlo_op_name")
+    i_occ = cols.index("occurrences")
+    i_avg = cols.index("avg_self_time")
+    rows = []
+    for r in obj["rows"]:
+        vals = [c["v"] if isinstance(c, dict) else c for c in r["c"]]
+        rows.append((str(vals[i_cat]), str(vals[i_name]),
+                     float(vals[i_occ]), float(vals[i_avg])))
+    return rows
+
+
+def measure(fn, args, steps=6, label=""):
+    import jax
+    import jax.numpy as jnp
+
+    g = fn(*args)  # compile
+    float(jnp.sum(jax.tree_util.tree_leaves(g)[0][(0,) * 2].astype(
+        jnp.float32)))
+    td = tempfile.mkdtemp(prefix="pkf")
+    with jax.profiler.trace(td):
+        for _ in range(steps):
+            g = fn(*args)
+        float(jnp.sum(jax.tree_util.tree_leaves(g)[0][(0,) * 2].astype(
+            jnp.float32)))
+    pbs = glob.glob(td + "/**/*.xplane.pb", recursive=True)
+    rows = hlo_self_times(pbs[0])
+    total_us = sum(occ * avg for _, _, occ, avg in rows) / steps
+    kern_us = sum(occ * avg for cat, _, occ, avg in rows
+                  if cat == "custom-call") / steps
+    fmt_us = sum(occ * avg for cat, n, occ, avg in rows
+                 if cat in ("copy", "transpose", "reshape")
+                 or "transpose" in n.lower() and cat == "fusion") / steps
+    print(f"{label:10s} total {total_us/1e3:7.3f} ms/step | "
+          f"kernels {kern_us/1e3:7.3f} | layout-ish {fmt_us/1e3:7.3f}")
+    return total_us
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from paddle_tpu.ops.pallas_attention import (
+        flash_attention, flash_attention_packed)
+
+    b, t, h, d = 8, 4096, 6, 128
+    rng = np.random.default_rng(0)
+    qp, kp, vp = (jnp.asarray(rng.normal(size=(b, t, h * d)) * 0.3,
+                              jnp.bfloat16) for _ in range(3))
+
+    def loss4(q, k, v):
+        # the model path: packed stream -> reshape -> 4-D api (which
+        # transposes) -> reshape back, exactly as multi_head_attention did
+        o = flash_attention(q.reshape(b, t, h, d), k.reshape(b, t, h, d),
+                            v.reshape(b, t, h, d), causal=True)
+        return jnp.sum(o.reshape(b, t, h * d).astype(jnp.float32) * 1e-3)
+
+    def lossp(q, k, v):
+        o = flash_attention_packed(q, k, v, h, causal=True)
+        return jnp.sum(o.astype(jnp.float32) * 1e-3)
+
+    f4 = jax.jit(jax.grad(loss4, argnums=(0, 1, 2)))
+    fp = jax.jit(jax.grad(lossp, argnums=(0, 1, 2)))
+    t4 = measure(f4, (qp, kp, vp), label="transpose")
+    tp = measure(fp, (qp, kp, vp), label="packed")
+    print(f"speedup {t4 / tp:.3f}x  ({(t4-tp)/1e3:.3f} ms/layer saved; "
+          f"x12 layers = {(t4-tp)*12/1e3:.1f} ms/step)")
+
+    # numerics on chip
+    o4 = flash_attention(qp.reshape(b, t, h, d), kp.reshape(b, t, h, d),
+                         vp.reshape(b, t, h, d), causal=True)
+    op = flash_attention_packed(qp, kp, vp, h, causal=True)
+    err = float(jnp.max(jnp.abs(op.astype(jnp.float32)
+                                - o4.reshape(b, t, h * d).astype(
+                                    jnp.float32))))
+    print(f"on-chip packed-vs-4d max abs err: {err:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,7 @@ __all__ = [
     "matmul",
     "mul",
     "flash_attention",
+    "flash_attention_packed",
     "multi_head_attention",
     "nested_sequence_pool",
     "nested_sequence_expand",
@@ -861,6 +862,25 @@ def sequence_softmax(x, name=None):
     return _link_length(out, x)
 
 
+def flash_attention_packed(q, k, v, n_head, causal=False, sm_scale=None,
+                           name=None):
+    """Fused attention on the raw projection layout: q/k/v [b, t, h*d]
+    (what the QKV matmuls emit) -> [b, t, h*d] (what the out-projection
+    consumes).  No [b,t,h,d]<->[bh,t,d] pack/unpack transposes exist —
+    heads are lane slices in the kernel's block index maps
+    (ops/pallas_attention.py).  Requires d_head % 128 == 0 or n_head 1."""
+    helper = LayerHelper("flash_attention_packed", name=name)
+    out = helper.create_tmp_variable(q.dtype, q.shape)
+    helper.append_op(
+        type="flash_attention_packed",
+        inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
+        outputs={"Out": [out.name]},
+        attrs={"n_head": int(n_head), "causal": bool(causal),
+               "sm_scale": 0.0 if sm_scale is None else float(sm_scale)},
+    )
+    return out
+
+
 def softmax(x, name=None):
     helper = LayerHelper("softmax", name=name)
     out = helper.create_tmp_variable(x.dtype, list(x.shape))
@@ -921,12 +941,19 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
            name=None if name is None else name + "_k")
     v = fc(values, d_model, num_flatten_dims=2, param_attr=_proj_attr("v"),
            name=None if name is None else name + "_v")
-    qh = reshape(q, [b, tq, n_head, dh])
-    kh = reshape(k, [b, tk, n_head, dh])
-    vh = reshape(v, [b, tk, n_head, dh])
-    ctx = flash_attention(qh, kh, vh, causal=causal,
-                          sm_scale=1.0 / float(dh) ** 0.5)
-    ctx = reshape(ctx, [b, tq, d_model])
+    if dh % 128 == 0 or n_head == 1:
+        # lane-aligned head width: the packed kernel takes the projection
+        # outputs as-is and no head pack/unpack transposes exist (8% of
+        # flagship device time on the 4-D path — RESULTS.md round 4/5)
+        ctx = flash_attention_packed(q, k, v, n_head, causal=causal,
+                                     sm_scale=1.0 / float(dh) ** 0.5)
+    else:
+        qh = reshape(q, [b, tq, n_head, dh])
+        kh = reshape(k, [b, tk, n_head, dh])
+        vh = reshape(v, [b, tk, n_head, dh])
+        ctx = flash_attention(qh, kh, vh, causal=causal,
+                              sm_scale=1.0 / float(dh) ** 0.5)
+        ctx = reshape(ctx, [b, tq, d_model])
     out = fc(ctx, d_model, num_flatten_dims=2, param_attr=_proj_attr("out"),
              name=None if name is None else name + "_out")
     if dropout_rate:

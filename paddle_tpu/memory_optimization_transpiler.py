@@ -129,7 +129,8 @@ def _cut_cost(graph, i, exclude):
 # remat policy keeps them OUTSIDE jax.checkpoint wrappers so their
 # custom-VJP residuals (e.g. flash attention's o + lse, the fused CE
 # head's lse) stay saved and the kernels never re-run.
-EXPENSIVE_OPS = ("flash_attention", "fused_softmax_ce_head", "scan_block",
+EXPENSIVE_OPS = ("flash_attention", "flash_attention_packed",
+                 "fused_softmax_ce_head", "scan_block",
                  "nested_rnn", "warpctc")
 
 # MXU ops: the selective policy also keeps these saved — on TPU the right

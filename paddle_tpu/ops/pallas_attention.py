@@ -129,12 +129,46 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[0] = (m_scr[...] + jnp.log(l_safe))[:, :1]
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _packed_geom(q, k, n_head):
+    """Shapes + block-index maps for the two supported layouts.
+
+    ``n_head=None``: q/k/v are [b*h, t, d] (the packed-by-transpose layout
+    the 4-D public API produces).  ``n_head=h``: q/k/v are [b, t, h*d] —
+    the RAW projection output.  Heads live in the lane dimension, so each
+    grid cell's block is a 128-aligned lane slice selected by the INDEX
+    MAP ((i // h, ·, i % h) block coords) and no [b,t,h,d]<->[bh,t,d]
+    transpose ever exists.  (A 4-D h-sliced BlockSpec is rejected by the
+    Mosaic tiling rules — see RESULTS.md round 4; the lane-slice form is
+    the legal spelling of the same thing, requiring d % 128 == 0.)
+
+    Returns (bh, t_q, t_k, d, qix, kix) where qix/kix map (grid cell,
+    q-or-k block index) -> block coords for q-shaped / k-shaped arrays.
+    """
+    if n_head is None:
+        bh, t_q, d = q.shape
+        t_k = k.shape[1]
+
+        def qix(i, blk):
+            return (i, blk, 0)
+
+        return bh, t_q, t_k, d, qix, qix
+    h = n_head
+    b, t_q, hd = q.shape
+    t_k = k.shape[1]
+    d = hd // h
+
+    def pix(i, blk):
+        return (i // h, blk, i % h)
+
+    return b * h, t_q, t_k, d, pix, pix
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+               n_head=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bh, t_q, d = q.shape
-    t_k = k.shape[1]
+    bh, t_q, t_k, d, qix, kix = _packed_geom(q, k, n_head)
     block_q = _pick_block(t_q, block_q)
     block_k = _pick_block(t_k, block_k)
     nk = t_k // block_k
@@ -148,20 +182,25 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # l
         pltpu.VMEM((block_q, d), jnp.float32),          # acc
     ]
+    # lse stays [bh, t_q, 1] in BOTH layouts: it is a per-token scalar
+    # (1.5 MB at the flagship shape) so writing it row-major-by-(b,h)
+    # costs nothing — grid cell i owns row i = b_idx*h + h_idx, and the
+    # backward kernels read it back with the same (i, j, 0) map.  Only
+    # the O(t*d) tensors need the lane-slice maps to dodge transposes.
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, t_q // block_q, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: qix(i, j)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: kix(i, kb)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: kix(i, kb)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: qix(i, j)),
             pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
         ],
         scratch_shapes=scratch,
@@ -412,41 +451,45 @@ FUSED_BWD_PARTIAL_BYTES = 512 << 20
 
 
 def _flash_bwd_fused(q, k, v, o, lse, do, sm_scale, causal, block_q,
-                     block_k, interpret, dlse=None):
+                     block_k, interpret, dlse=None, n_head=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bh, t_q, d = q.shape
-    t_k = k.shape[1]
+    bh, t_q, t_k, d, qix, kix = _packed_geom(q, k, n_head)
     block_q = _pick_block(t_q, block_q)
     block_k = _pick_block(t_k, block_k)
     nq = t_q // block_q
     nk = t_k // block_k
     has_dlse = dlse is not None
 
-    kspec = pl.BlockSpec((1, block_k, d), lambda i, kb, jq: (i, kb, 0))
-    qspec = pl.BlockSpec((1, block_q, d), lambda i, kb, jq: (i, jq, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, kb, jq: kix(i, kb))
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, kb, jq: qix(i, jq))
     qstat = pl.BlockSpec((1, block_q, 1), lambda i, kb, jq: (i, jq, 0))
     in_specs = [kspec, kspec, qspec, qspec, qspec, qstat]
     args = [k, v, q, do, o, lse]
     if has_dlse:
         in_specs.append(qstat)
         args.append(dlse)
+    if n_head is None:
+        dqp_spec = pl.BlockSpec((1, 1, block_q, d),
+                                lambda i, kb, jq: (kb, i, jq, 0))
+        dqp_shape = jax.ShapeDtypeStruct((nk, bh, t_q, d), q.dtype)
+    else:
+        h = n_head
+        dqp_spec = pl.BlockSpec((1, 1, block_q, d),
+                                lambda i, kb, jq: (kb, i // h, jq, i % h))
+        dqp_shape = jax.ShapeDtypeStruct((nk,) + q.shape, q.dtype)
     dq_part, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q, block_k=block_k,
                           nq=nq, has_dlse=has_dlse),
         grid=(bh, nk, nq),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda i, kb, jq: (kb, i, jq, 0)),
-            kspec, kspec,
-        ],
+        out_specs=[dqp_spec, kspec, kspec],
         out_shape=[
-            jax.ShapeDtypeStruct((nk, bh, t_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, t_k, d), v.dtype),
+            dqp_shape,
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -457,19 +500,19 @@ def _flash_bwd_fused(q, k, v, o, lse, do, sm_scale, causal, block_q,
 
 
 def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
-               interpret, dlse=None):
+               interpret, dlse=None, n_head=None):
     """Pallas backward.  Short/medium t: one fused kernel (s recomputed
     once per block pair, dq as per-k-block partials).  Long t (partials
     over budget): dq kernel (q-major) + dk/dv kernel (k-major), both with
     causal block skip; O(block^2) VMEM.  ``lse`` and the optional ``dlse``
     (the cotangent of the returned lse, for callers that consume it —
     ring-attention merges) arrive in the narrow [bh, t_q, 1] residual
-    layout."""
+    layout in BOTH q/k/v layouts (packed mode keeps lse row-major by
+    (b, h) — see the forward's lse note)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bh, t_q, d = q.shape
-    t_k = k.shape[1]
+    bh, t_q, t_k, d, qix, kix = _packed_geom(q, k, n_head)
     block_q = _pick_block(t_q, block_q)
     block_k = _pick_block(t_k, block_k)
     nq = t_q // block_q
@@ -479,10 +522,11 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
     part_bytes = nk * bh * t_q * d * q.dtype.itemsize
     if part_bytes <= FUSED_BWD_PARTIAL_BYTES:
         return _flash_bwd_fused(q, k, v, o, lse, do, sm_scale, causal,
-                                block_q, block_k, interpret, dlse=dlse)
+                                block_q, block_k, interpret, dlse=dlse,
+                                n_head=n_head)
 
-    qspec = pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0))
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, j, kb: qix(i, j))
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, j, kb: kix(i, kb))
     qstat = pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0))
     dq_in_specs = [qspec, kspec, kspec, qspec, qspec, qstat]
     dq_args = [q, k, v, do, o, lse]
@@ -496,14 +540,14 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
         grid=(bh, nq, nk),
         in_specs=dq_in_specs,
         out_specs=[qspec],
-        out_shape=[jax.ShapeDtypeStruct((bh, t_q, d), q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
                         pltpu.VMEM((block_q, LSE_LANES), jnp.float32)],
         interpret=interpret,
     )(*dq_args)[0]
 
-    kspec2 = pl.BlockSpec((1, block_k, d), lambda i, kb, jq: (i, kb, 0))
-    qspec2 = pl.BlockSpec((1, block_q, d), lambda i, kb, jq: (i, jq, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda i, kb, jq: kix(i, kb))
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda i, kb, jq: qix(i, jq))
     qstat2 = pl.BlockSpec((1, block_q, 1), lambda i, kb, jq: (i, jq, 0))
     dkv_in_specs = [kspec2, kspec2, qspec2, qspec2, qspec2, qstat2]
     dkv_args = [k, v, q, do, o, lse]
@@ -517,8 +561,8 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
         grid=(bh, nk, nq),
         in_specs=dkv_in_specs,
         out_specs=[kspec2, kspec2],
-        out_shape=[jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, t_k, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
@@ -526,21 +570,26 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                n_head=None):
+    o, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                      n_head=n_head)
     return o
 
 
-def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                    n_head=None):
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret, n_head=n_head)
     return o, (q, k, v, o, lse)
 
 
-def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, n_head,
+                    res, do):
     q, k, v, o, lse = res
     return _flash_bwd(q, k, v, o, lse[:, :, None], do, sm_scale, causal,
-                      block_q, block_k, interpret)
+                      block_q, block_k, interpret, n_head=n_head)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -564,7 +613,7 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=1024,
     o = _flash_core(
         pack(q, t_q), pack(k, t_k), pack(v, t_k),
         float(sm_scale), bool(causal), int(block_q), int(block_k),
-        bool(interpret),
+        bool(interpret), None,
     )
     return jnp.swapaxes(o.reshape(b, h, t_q, d), 1, 2)
 
@@ -619,6 +668,39 @@ def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
             lse.reshape(b, h, t_q))
 
 
+
+def flash_attention_packed(q, k, v, n_head, causal=False, sm_scale=None,
+                           block_q=1024, block_k=1024, interpret=None):
+    """Fused attention on the RAW projection layout: q/k/v [b, t, h*d]
+    (heads concatenated in the feature dim, exactly what the QKV matmuls
+    emit) -> o [b, t, h*d] (exactly what the output projection consumes).
+
+    Numerically identical to ``flash_attention`` on the reshaped 4-D view,
+    but the [b,t,h,d]<->[b*h,t,d] pack/unpack transposes — 23 ms/step on
+    the GPT flagship, 8% of device time (RESULTS.md round 4) — never
+    exist: each head is a 128-aligned lane slice selected by the kernels'
+    block index maps.  Requires ``d_head % 128 == 0`` (the Mosaic lane
+    tile) unless ``n_head == 1``; callers with other head widths use
+    ``flash_attention``."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t_q, hd = q.shape
+    if hd % n_head:
+        raise ValueError(f"feature dim {hd} not divisible by n_head {n_head}")
+    d = hd // n_head
+    if n_head > 1 and d % 128 and not interpret:
+        # interpret mode has no Mosaic tiling rules — CPU tests exercise
+        # small head widths through the identical code path
+        raise ValueError(
+            f"flash_attention_packed needs d_head % 128 == 0 (lane-aligned "
+            f"head slices), got d_head={d}; use flash_attention for other "
+            f"head widths")
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    return _flash_core(
+        q, k, v, float(sm_scale), bool(causal), int(block_q), int(block_k),
+        bool(interpret), int(n_head))
+
+
 def attention_reference(q, k, v, causal=False, sm_scale=None):
     """Dense reference implementation (for tests and tiny shapes)."""
     d = q.shape[-1]
@@ -641,3 +723,15 @@ from ..core.registry import register_op
 def flash_attention_op(Q, K, V, causal=False, sm_scale=0.0, **_):
     scale = None if not sm_scale else float(sm_scale)
     return {"Out": flash_attention(Q, K, V, causal=causal, sm_scale=scale)}
+
+
+@register_op("flash_attention_packed")
+def flash_attention_packed_op(Q, K, V, n_head=None, causal=False,
+                              sm_scale=0.0, **_):
+    if n_head is None:
+        # no safe default: 1 would silently softmax across the whole
+        # concatenated h*d feature dim as a single head
+        raise ValueError("flash_attention_packed op requires the n_head attr")
+    scale = None if not sm_scale else float(sm_scale)
+    return {"Out": flash_attention_packed(
+        Q, K, V, int(n_head), causal=causal, sm_scale=scale)}
