@@ -114,6 +114,12 @@ def bench_gpt(n_chips, mesh_factory, steps, warmup):
             vocab_size=vocab, n_layer=n_layer, n_head=n_head,
             d_model=d_model, max_len=seq, dropout_rate=0.0,
             dtype="bfloat16", fused_head=fused)
+        accum = int(os.environ.get("BENCH_GPT_ACCUM", "1"))
+        if accum > 1:
+            # microbatch accumulation: activation memory scales with
+            # batch/accum — the capacity lever that fits t=16k WITHOUT
+            # paying full-remat recompute (RESULTS.md round-5 table)
+            pt.gradient_accumulation(main_prog, accum)
         remat = os.environ.get("BENCH_GPT_REMAT", "0").lower()
         if remat not in ("0", "", "false"):
             # selective (default): saves kernel residuals + MXU outputs,

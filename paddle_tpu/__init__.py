@@ -65,7 +65,8 @@ from . import distributed
 from . import flags
 from .flags import FLAGS
 from . import memory_optimization_transpiler
-from .memory_optimization_transpiler import memory_optimize, release_memory
+from .memory_optimization_transpiler import (
+    gradient_accumulation, memory_optimize, release_memory)
 from . import checkgrad
 from .checkgrad import check_gradients
 from . import compat

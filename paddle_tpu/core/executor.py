@@ -475,12 +475,22 @@ class Executor:
                     for slot_names in op_.inputs.values():
                         aux_names.update(slot_names)
 
-                def fwd(tparams, env0):
+                def make_fwd(fctx):
+                    """Differentiable forward bound to one LoweringCtx —
+                    gradient accumulation builds one per microbatch so
+                    random ops draw distinct keys."""
+
+                    def fwd(tparams, env0):
+                        return _run_fwd(fctx, tparams, env0)
+
+                    return fwd
+
+                def _run_fwd(fctx, tparams, env0):
                     e = dict(env0)
                     e.update(tparams)
                     if not segments:
                         run_block_ops(
-                            ctx, block, block.ops[:bw], e,
+                            fctx, block, block.ops[:bw], e,
                             inside_grad_prefix=True,
                         )
                     else:
@@ -523,7 +533,7 @@ class Executor:
                             seg_ops = block.ops[s:t]
                             if not wrap:
                                 run_block_ops(
-                                    ctx, block, seg_ops, e,
+                                    fctx, block, seg_ops, e,
                                     inside_grad_prefix=True,
                                 )
                                 continue
@@ -536,14 +546,14 @@ class Executor:
                             # checkpoint may trace seg_fn more than once;
                             # pin the random-op key counter to the segment
                             # start so fwd and remat derive identical keys
-                            c0 = ctx._op_counter
+                            c0 = fctx._op_counter
 
                             def seg_fn(env_in, _ops=seg_ops, _out=out_names,
                                        _c0=c0):
-                                ctx._op_counter = _c0
+                                fctx._op_counter = _c0
                                 e2 = dict(env_in)
                                 run_block_ops(
-                                    ctx, block, _ops, e2,
+                                    fctx, block, _ops, e2,
                                     inside_grad_prefix=True,
                                 )
                                 return {n: e2[n] for n in _out if n in e2}
@@ -563,8 +573,16 @@ class Executor:
                     return jnp.sum(loss), aux
 
                 tparams = {n: env[n] for n in param_names}
-                grads, aux = jax.grad(fwd, has_aux=True)(tparams, env)
-                env.update(aux)
+                accum = int(getattr(program, "_grad_accum", 1) or 1)
+                if accum <= 1:
+                    grads, aux = jax.grad(make_fwd(ctx), has_aux=True)(
+                        tparams, env)
+                    env.update(aux)
+                else:
+                    grads, aux = self._accum_grads(
+                        program, block, ctx, env, tparams, make_fwd,
+                        feed_names, persist_out, accum, step_key, bw)
+                    env.update(aux)
                 for n, g in grads.items():
                     env[n + GRAD_SUFFIX] = g
                 run_block_ops(ctx, block, block.ops[bw:], env)
@@ -575,6 +593,86 @@ class Executor:
             return new_state, fetches
 
         return step, persist_out
+
+    def _accum_grads(self, program, block, ctx, env, tparams, make_fwd,
+                     feed_names, persist_out, accum, step_key, bw):
+        """Gradient accumulation (``pt.gradient_accumulation``): slice the
+        feed batch into ``accum`` microbatches, run forward+backward per
+        microbatch under ``lax.scan`` (activation memory scales with the
+        microbatch), accumulate gradients in float32, and return the MEAN
+        gradient — the big-batch average-loss gradient when microbatches
+        weigh equally.  Forward-written persistables (BN stats, metric
+        accumulators) thread through the scan carry so microbatch k+1 sees
+        k's updates, exactly as consecutive small steps would."""
+        mbs = {}
+        for n in feed_names:
+            if jnp.ndim(env[n]) == 0:
+                continue  # 0-d feeds (scalars) pass through unsplit
+            b0 = env[n].shape[0]
+            if b0 % accum:
+                raise ValueError(
+                    f"gradient_accumulation(micro_steps={accum}): feed "
+                    f"{n!r} leading dim {b0} is not divisible")
+            mbs[n] = b0 // accum
+        full_b = env[feed_names[0]].shape[0] if mbs else 0
+
+        fwd_written = {
+            n for op in block.ops[:bw] for n in op.output_names()
+        }
+        carry_persist = sorted(
+            n for n in persist_out if n in fwd_written and n in env
+        )
+
+        def one_micro(carry, i):
+            gacc, persist = carry
+            e0 = dict(env)
+            e0.update(persist)
+            for n, mb in mbs.items():
+                e0[n] = jax.lax.dynamic_slice_in_dim(
+                    env[n], i * mb, mb, 0)
+            fctx = LoweringCtx(
+                self, program, jax.random.fold_in(step_key, i + 1))
+            g, aux = jax.grad(make_fwd(fctx), has_aux=True)(tparams, e0)
+            gacc = jax.tree_util.tree_map(
+                lambda a, gi: a + gi.astype(jnp.float32), gacc, g)
+            new_persist = {n: aux[n] for n in carry_persist}
+            # parameters are optimizer-op inputs, so they sit in aux too —
+            # but the forward never writes them and env already holds the
+            # exact values; stacking them across the scan would cost
+            # accum x param-bytes of HBM for nothing
+            ys = {n: v for n, v in aux.items()
+                  if n not in new_persist and n not in tparams}
+            return (gacc, new_persist), ys
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), tparams)
+        p0 = {n: env[n] for n in carry_persist}
+        (gsum, persist_f), ys = jax.lax.scan(
+            one_micro, (g0, p0), jnp.arange(accum))
+        grads = {
+            n: (gsum[n] / accum).astype(env[n].dtype) for n in gsum
+        }
+        aux = dict(persist_f)
+        for n, y in ys.items():
+            # classify by the var's STATIC leading dim, not the runtime
+            # shape (a [1]-shaped mean fetch with microbatch 1 must not be
+            # mistaken for batch data): -1 or the full feed batch means
+            # batch-leading -> microbatch results concatenate back.
+            var = block._find_var(n)
+            vshape = tuple(var.shape) if var is not None else ()
+            batch_leading = (
+                y.ndim >= 2 and len(vshape) >= 1
+                and (vshape[0] == -1 or (full_b and vshape[0] == full_b))
+            )
+            if batch_leading:
+                aux[n] = y.reshape((-1,) + y.shape[2:])
+            elif jnp.issubdtype(y.dtype, jnp.inexact):
+                # scalar metrics (avg loss): mean of equal-weight
+                # microbatch averages == the big-batch average
+                aux[n] = jnp.mean(y, axis=0)
+            else:
+                aux[n] = y[-1]
+        return grads, aux
 
     def _compile(self, program, feed_names, fetch_names, state_names):
         step, persist_out = self.lower(

@@ -254,6 +254,31 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
     return segments
 
 
+def gradient_accumulation(input_program=None, micro_steps=1):
+    """Split every training step into ``micro_steps`` microbatches: the
+    feed batch is sliced along its leading axis, forward+backward runs per
+    microbatch under ``lax.scan``, gradients accumulate in float32, and
+    the optimizer applies ONCE with the mean gradient — the memory lever
+    that lets remat policies lighter than ``full`` fit long-context shapes
+    (activation memory scales with the microbatch, gradients are one
+    param-sized buffer).  Mean-of-microbatch-averages equals the big-batch
+    average-loss gradient when microbatches carry equal loss weight (the
+    same-math-different-schedule contract of the reference's
+    ``test_CompareTwoNets.cpp``); ``tests/test_grad_accum.py`` pins it.
+
+    Composes with ``memory_optimize``: segments apply inside each
+    microbatch.  Feed leading dims must divide by ``micro_steps``."""
+    from .core.program import default_main_program
+
+    program = input_program or default_main_program()
+    micro_steps = int(micro_steps)
+    if micro_steps < 1:
+        raise ValueError(f"micro_steps must be >= 1, got {micro_steps}")
+    program._grad_accum = micro_steps
+    program._bump_version()
+    return program
+
+
 def release_memory(input_program=None):
     """Reference API parity (drop-in no-op: XLA frees/reuses buffers inside
     the compiled step; remat via memory_optimize is the active knob)."""

@@ -1,0 +1,169 @@
+"""Gradient accumulation: same math as the big batch, different schedule —
+the reference's ``test_CompareTwoNets.cpp`` contract (same network, two
+execution schedules, compared numerically)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _build_mlp(lr=0.1):
+    model = {}
+    img = pt.layers.data("x", shape=[16], dtype="float32")
+    lbl = pt.layers.data("y", shape=[1], dtype="int64")
+    h = pt.layers.fc(img, 32, act="tanh")
+    pred = pt.layers.fc(h, 4, act="softmax")
+    cost = pt.layers.cross_entropy(pred, lbl)
+    avg = pt.layers.mean(cost)
+    opt = pt.optimizer.SGD(learning_rate=lr)
+    opt.minimize(avg)
+    model["feed"] = [img, lbl]
+    model["avg_cost"] = avg
+    model["pred"] = pred
+    return model
+
+
+def _train(accum, steps=3, seed=7):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 11
+    with pt.program_guard(main, startup):
+        model = _build_mlp()
+    if accum > 1:
+        pt.gradient_accumulation(main, accum)
+    scope = pt.core.scope.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    y = rng.integers(0, 4, (8, 1)).astype(np.int64)
+    losses, preds = [], None
+    for _ in range(steps):
+        loss, preds = exe.run(main, feed={"x": x, "y": y},
+                              fetch_list=[model["avg_cost"], model["pred"]],
+                              scope=scope)
+        losses.append(float(np.asarray(loss)))
+    params = {
+        p.name: np.asarray(scope.get(p.name))
+        for p in main.all_parameters()
+    }
+    return losses, np.asarray(preds), params
+
+
+def test_accum_matches_big_batch():
+    """accum=4 over an 8-row batch == one 8-row step: losses, the
+    concatenated batch-shaped fetch, and the updated parameters."""
+    l1, p1, w1 = _train(1)
+    l4, p4, w4 = _train(4)
+    np.testing.assert_allclose(l1, l4, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(p1, p4, rtol=2e-4, atol=1e-5)
+    # param names are auto-numbered per process (fc_0 vs fc_2...); the two
+    # builds produce the same parameters in the same creation order
+    assert len(w1) == len(w4)
+    for (n1, a), (n4, b) in zip(sorted(w1.items()), sorted(w4.items())):
+        assert a.shape == b.shape, (n1, n4)
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5,
+                                   err_msg=f"{n1} vs {n4}")
+
+
+def test_accum_indivisible_batch_errors():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        model = _build_mlp()
+    pt.gradient_accumulation(main, 3)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.core.scope.Scope()
+    exe.run(startup, scope=scope)
+    x = np.zeros((8, 16), np.float32)
+    y = np.zeros((8, 1), np.int64)
+    with pytest.raises(Exception, match="not divisible"):
+        exe.run(main, feed={"x": x, "y": y},
+                fetch_list=[model["avg_cost"]], scope=scope)
+
+
+def test_accum_with_remat_policy():
+    """gradient_accumulation composes with memory_optimize segments."""
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 5
+    with pt.program_guard(main, startup):
+        model = _build_mlp()
+    ref_main, ref_startup = pt.Program(), pt.Program()
+    ref_main.random_seed = 5
+    with pt.program_guard(ref_main, ref_startup):
+        ref_model = _build_mlp()
+    pt.gradient_accumulation(main, 2)
+    pt.memory_optimize(main, policy="full", min_segment=1)
+
+    def run(prog, startup, model):
+        scope = pt.core.scope.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 16)).astype(np.float32)
+        y = rng.integers(0, 4, (4, 1)).astype(np.int64)
+        for _ in range(2):
+            loss, = exe.run(prog, feed={"x": x, "y": y},
+                            fetch_list=[model["avg_cost"]], scope=scope)
+        return float(np.asarray(loss))
+
+    la = run(main, startup, model)
+    lb = run(ref_main, ref_startup, ref_model)
+    np.testing.assert_allclose(la, lb, rtol=2e-5, atol=1e-6)
+
+
+def test_accum_bn_stats_thread_through_microbatches():
+    """Forward-written persistables (BN running stats) must see each
+    microbatch sequentially — the final stats equal running the two
+    microbatches as two separate steps."""
+
+    def build():
+        x = pt.layers.data("x", shape=[6], dtype="float32")
+        lbl = pt.layers.data("y", shape=[1], dtype="float32")
+        h = pt.layers.fc(x, 8)
+        h = pt.layers.batch_norm(h)
+        pred = pt.layers.fc(h, 1)
+        cost = pt.layers.mean(pt.layers.square_error_cost(pred, lbl))
+        pt.optimizer.SGD(learning_rate=0.0).minimize(cost)
+        return cost
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 6)).astype(np.float32) * 3.0
+    y = rng.normal(size=(8, 1)).astype(np.float32)
+
+    # accum=2 on the full batch
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 1
+    with pt.program_guard(main, startup):
+        cost = build()
+    pt.gradient_accumulation(main, 2)
+    s1 = pt.core.scope.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=s1)
+    exe.run(main, feed={"x": x, "y": y}, fetch_list=[cost], scope=s1)
+
+    # two sequential half-batch steps (lr=0 so only BN stats move)
+    main2, startup2 = pt.Program(), pt.Program()
+    main2.random_seed = 1
+    with pt.program_guard(main2, startup2):
+        cost2 = build()
+    s2 = pt.core.scope.Scope()
+    exe.run(startup2, scope=s2)
+    exe.run(main2, feed={"x": x[:4], "y": y[:4]}, fetch_list=[cost2],
+            scope=s2)
+    exe.run(main2, feed={"x": x[4:], "y": y[4:]}, fetch_list=[cost2],
+            scope=s2)
+
+    def stats(scope):
+        # auto-numbered names differ between the two builds; sort by the
+        # (suffix, name) so mean pairs with mean, variance with variance
+        names = sorted(
+            (n for n in scope.var_names() if "batch_norm" in n
+             and ("mean" in n or "variance" in n)),
+            key=lambda n: n.rsplit(".", 1)[-1])
+        return [(n, np.asarray(scope.get(n))) for n in names]
+
+    st1, st2 = stats(s1), stats(s2)
+    assert st1 and len(st1) == len(st2)
+    for (n1, a), (n2, b) in zip(st1, st2):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"{n1} vs {n2}")
