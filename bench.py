@@ -34,21 +34,29 @@ def chip_peak_flops(device):
     return float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
 
-def timed_steps(exe, prog, feed, fetch, steps, warmup):
-    """Warm up, then time `steps` training steps with async dispatch:
-    fetches stay on device so steps pipeline (a per-step host sync would
-    add the full host<->device latency to every batch); block once at the
-    end for honest timing.  The end-of-region np.asarray forces a real
-    host materialization — through the axon tunnel block_until_ready()
-    alone does not reliably wait.  Returns (seconds, last fetches)."""
+def timed_steps(exe, prog, feed, fetch, steps, warmup, repeats=None):
+    """Warm up, then time ``repeats`` independent regions of ``steps``
+    training steps each (async dispatch: fetches stay on device so steps
+    pipeline; one host materialization per region for honest timing —
+    through the axon tunnel block_until_ready() alone does not reliably
+    wait).  Single-run numbers on a shared chip are indistinguishable
+    from variance (the round-4 ResNet 2,403->2,326 "regression" was
+    noise); returns (median_seconds, [all region seconds], last fetches).
+    """
+    if repeats is None:
+        repeats = int(os.environ.get("BENCH_REPEATS", "5"))
     for _ in range(warmup):
         exe.run(prog, feed=feed, fetch_list=fetch)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        cost = exe.run(prog, feed=feed, fetch_list=fetch,
-                       return_numpy=False)
-    cost = [np.asarray(c) for c in cost]
-    return time.perf_counter() - t0, cost
+    times = []
+    cost = None
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            cost = exe.run(prog, feed=feed, fetch_list=fetch,
+                           return_numpy=False)
+        cost = [np.asarray(c) for c in cost]
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), times, cost
 
 
 def shard_batch(arrays, mesh):
@@ -84,10 +92,12 @@ def bench_resnet(n_chips, mesh_factory, steps, warmup):
     img = jnp.asarray(np.random.rand(batch, 3, 224, 224), jnp.bfloat16)
     label = jnp.asarray(np.random.randint(0, 1000, (batch, 1)), jnp.int32)
     img, label = shard_batch([img, label], mesh)
-    dt, cost = timed_steps(exe, main_prog, {"img": img, "label": label},
-                           [outs["avg_cost"]], steps, warmup)
+    dt, times, cost = timed_steps(exe, main_prog,
+                                  {"img": img, "label": label},
+                                  [outs["avg_cost"]], steps, warmup)
     assert np.isfinite(cost[0]).all()
-    return batch * steps / dt / n_chips
+    rates = [batch * steps / t / n_chips for t in times]
+    return batch * steps / dt / n_chips, min(rates), max(rates)
 
 
 def bench_gpt(n_chips, mesh_factory, steps, warmup):
@@ -138,9 +148,9 @@ def bench_gpt(n_chips, mesh_factory, steps, warmup):
     labels = jnp.asarray(np.random.randint(0, vocab, (batch, seq)),
                          jnp.int32)
     toks, labels = shard_batch([toks, labels], mesh)
-    dt, cost = timed_steps(exe, main_prog,
-                           {"tokens": toks, "labels": labels},
-                           [outs["avg_cost"]], steps, warmup)
+    dt, times, cost = timed_steps(exe, main_prog,
+                                  {"tokens": toks, "labels": labels},
+                                  [outs["avg_cost"]], steps, warmup)
     assert np.isfinite(cost[0]).all()
 
     tokens_per_s = batch * seq * steps / dt
@@ -151,7 +161,8 @@ def bench_gpt(n_chips, mesh_factory, steps, warmup):
                   + 6 * n_layer * batch * seq * seq * d_model)
     peak = chip_peak_flops(jax.devices()[0]) * n_chips
     mfu = step_flops * steps / dt / peak
-    return tokens_per_s / n_chips, mfu
+    rates = [batch * seq * steps / t / n_chips for t in times]
+    return tokens_per_s / n_chips, mfu, min(rates), max(rates)
 
 
 def flash_numeric_gate():
@@ -188,6 +199,152 @@ def flash_numeric_gate():
     return worst
 
 
+def grad_numeric_gates():
+    """On-chip GRADIENT-level gates for the two kernels that carry the
+    flagship (round-4 weakness #5): the fused/packed flash backward and
+    the fused CE head's fwd+dx+dW, each vs its dense reference at the
+    flagship block geometry, f32-highest matmuls.  Returns
+    {gate_name: max_rel_err}; asserts sane bounds."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_attention import (
+        attention_reference, flash_attention_packed)
+    from paddle_tpu.ops.pallas_ce import (
+        fused_softmax_ce_head, fused_softmax_ce_head_reference)
+
+    out = {}
+    rng = np.random.default_rng(23)
+    # flash backward at the PRODUCTION geometry (bf16 inputs, 1024
+    # blocks, packed layout, fused bwd kernel engages at this size):
+    # dq/dk/dv vs the dense reference's autodiff.  The kernel runs at
+    # production precision (a `highest` matmul context makes Mosaic
+    # reject the bf16 dots — "Bad lhs type"); only the dense reference
+    # gets f32-highest.  f32 inputs would double the kernel's VMEM
+    # blocks past the scoped limit, so the gate runs the shipping dtype;
+    # the bound catches logic/masking bugs (O(1) errors), not bf16
+    # rounding (~1e-2).
+    b, t, h, d = 1, 4096, 2, 128
+    q4, k4, v4 = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5,
+                              jnp.bfloat16) for _ in range(3))
+    pk = lambda x: x.reshape(b, t, h * d)
+    wgt = jnp.cos(jnp.arange(b * t * h * d, dtype=jnp.float32)
+                  .reshape(b, t, h * d) * 1e-3)
+
+    def loss_flash(q, k, v):
+        o = flash_attention_packed(q, k, v, h, causal=True,
+                                   block_q=1024, block_k=1024)
+        return jnp.sum(o.astype(jnp.float32) * wgt)
+
+    def loss_dense(q, k, v):
+        q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
+        with jax.default_matmul_precision("highest"):
+            o = attention_reference(q, k, v, causal=True)
+        return jnp.sum(o.reshape(b, t, h * d) * wgt)
+
+    gf = jax.grad(loss_flash, (0, 1, 2))(pk(q4), pk(k4), pk(v4))
+    gd = jax.grad(loss_dense, (0, 1, 2))(q4, k4, v4)
+    worst = 0.0
+    for a, ref4 in zip(gf, gd):
+        ref = ref4.reshape(a.shape).astype(jnp.float32)
+        scale = float(jnp.max(jnp.abs(ref))) or 1.0
+        worst = max(worst, float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - ref))) / scale)
+    assert worst < 5e-2, f"flash bwd gradient gate FAILED: {worst:.2e}"
+    out["flash_bwd_grad_max_rel_err"] = round(worst, 7)
+
+    # fused CE head: loss + dx + dW vs the dense log-softmax head at the
+    # flagship vocab/d_model (fewer tokens so the dense [n, vocab]
+    # reference fits); bf16 inputs = the shipping dtype, reference in
+    # f32-highest
+    n, dm, vocab = 4096, 768, 32768
+    x = jnp.asarray(rng.normal(size=(n, dm)) * 0.3, jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(dm, vocab)) * 0.05, jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, vocab, (n,)), jnp.int32)
+    gvec = jnp.asarray(rng.normal(size=(n,)) * 0.1, jnp.float32)
+
+    def loss_fused(x, w):
+        return jnp.sum(fused_softmax_ce_head(x, w, y) * gvec)
+
+    def loss_ref(x, w):
+        x, w = x.astype(jnp.float32), w.astype(jnp.float32)
+        with jax.default_matmul_precision("highest"):
+            return jnp.sum(fused_softmax_ce_head_reference(x, w, y) * gvec)
+
+    lf = loss_fused(x, w)
+    lr = loss_ref(x, w)
+    worst = abs(float(lf - lr)) / (abs(float(lr)) or 1.0)
+    (dxf, dwf) = jax.grad(loss_fused, (0, 1))(x, w)
+    (dxr, dwr) = jax.grad(loss_ref, (0, 1))(x, w)
+    for a, ref in ((dxf, dxr), (dwf, dwr)):
+        ref = ref.astype(jnp.float32)
+        scale = float(jnp.max(jnp.abs(ref))) or 1.0
+        worst = max(worst, float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - ref))) / scale)
+    assert worst < 5e-2, f"CE head gradient gate FAILED: {worst:.2e}"
+    out["ce_head_grad_max_rel_err"] = round(worst, 7)
+    return out
+
+
+def memory_gate():
+    """Compile (no run) the two t=16k capacity configs and record their
+    device-memory footprints — the regression gate pinning the three
+    remat fixes (segment output trimming, the (s - s) dW data-tie, 2-D
+    narrow residuals; core/executor.py) and the accumulation fit.  A
+    toolchain bump that silently resurrects the 22.6 GB deferred-dW
+    behavior fails here at compile time instead of shipping.  Returns
+    {config: peak_gib}; asserts both fit the 16 GiB chip."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    def compiled_gib(accum, remat):
+        main_prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_prog, startup):
+            outs = transformer.build(
+                vocab_size=32768, n_layer=12, n_head=6, d_model=768,
+                max_len=16384, dropout_rate=0.0, dtype="bfloat16",
+                fused_head=True)
+            if accum > 1:
+                pt.gradient_accumulation(main_prog, accum)
+            if remat:
+                pt.memory_optimize(main_prog, policy=remat)
+        batch = 6  # the t=16k capacity configs both run global batch 6
+        exe = pt.Executor()
+        scope = pt.core.scope.Scope()
+        exe.run(startup, scope=scope)
+        feed_names = ["labels", "tokens"]
+        fetch = [outs["avg_cost"].name]
+        state_names = tuple(sorted(
+            v.name for v in main_prog.persistable_vars()
+            if scope.find_var(v.name) is not None))
+        step, persist_out = exe.lower(
+            main_prog, feed_names, fetch, state_names)
+        state = {n: scope.get(n) for n in state_names}
+        state[pt.core.scope.RNG_VAR] = scope.get(pt.core.scope.RNG_VAR)
+        toks = jnp.zeros((batch, 16384), jnp.int32)
+        compiled = (jax.jit(step, donate_argnums=0)
+                    .lower(state, toks, toks).compile())
+        mem = compiled.memory_analysis()
+        # XLA's own liveness-aware peak (donated weights alias outputs, so
+        # summing argument/output/temp sizes overcounts by ~3 GiB here)
+        peak = getattr(mem, "peak_memory_in_bytes", None)
+        if not peak:
+            peak = mem.output_size_in_bytes + mem.temp_size_in_bytes
+        del state, compiled
+        return peak / (1 << 30)
+
+    out = {}
+    for name, accum, remat in [("t16k_accum2_noremat", 2, None),
+                               ("t16k_bs6_full_remat", 1, "full")]:
+        gib = compiled_gib(accum, remat)
+        assert gib < 15.75, (
+            f"memory gate FAILED: {name} needs {gib:.2f} GiB > 15.75 "
+            f"(remat fixes regressed?)")
+        out[f"mem_{name}_gib"] = round(gib, 3)
+    return out
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
@@ -215,14 +372,24 @@ def main():
     extra = {}
     img_per_chip = None
     if "resnet" in which:
-        img_per_chip = bench_resnet(n_chips, mesh_factory, steps, warmup)
+        img_per_chip, img_min, img_max = bench_resnet(
+            n_chips, mesh_factory, steps, warmup)
+        extra["resnet_img_s_min"] = round(img_min, 1)
+        extra["resnet_img_s_max"] = round(img_max, 1)
     if "gpt" in which:
-        tok_per_chip, mfu = bench_gpt(n_chips, mesh_factory, steps, warmup)
+        tok_per_chip, mfu, tok_min, tok_max = bench_gpt(
+            n_chips, mesh_factory, steps, warmup)
         extra["gpt_tokens_per_sec_per_chip"] = round(tok_per_chip, 1)
         extra["gpt_mfu"] = round(mfu, 4)
+        extra["gpt_tok_s_min"] = round(tok_min, 1)
+        extra["gpt_tok_s_max"] = round(tok_max, 1)
     if os.environ.get("BENCH_FLASH_GATE", "1").lower() not in (
             "0", "", "false"):
         extra["flash_max_rel_err"] = round(flash_numeric_gate(), 7)
+        extra.update(grad_numeric_gates())
+    if os.environ.get("BENCH_MEM_GATE", "1").lower() not in (
+            "0", "", "false"):
+        extra.update(memory_gate())
 
     if img_per_chip is None:  # gpt-only run (BENCH_MODELS=gpt)
         print(json.dumps({
@@ -231,7 +398,7 @@ def main():
             "unit": "tok/s/chip",
             "vs_baseline": extra["gpt_mfu"],
             "extra": {k: v for k, v in extra.items()
-                      if k.startswith("flash")},
+                      if not k.startswith("gpt_tokens")},
         }))
         return
     target_per_chip = 3000.0 / 16.0

@@ -1,0 +1,81 @@
+"""Fused flash-backward block sweep at the flagship shape + dq-reduce cost.
+
+The round-5 step trace charges the fused backward 4.31 ms/layer of
+kernel time plus ~0.8 ms/layer of `reduce` (the [nk, b, t, h*d] dq
+partial sums).  This sweep asks two questions on the chip:
+1. does any (block_q, block_k) beat 1024x1024 for the BACKWARD kernel;
+2. what does the dq partial reduction actually cost (kernel vs total).
+
+Usage: python benchmarks/bwd_blocks_4k.py
+"""
+
+import glob
+import json
+import sys
+import tempfile
+
+import numpy as np
+
+
+def hlo_times(pb_path):
+    from xprof.convert import raw_to_tool_data as r2t
+
+    data, _ = r2t.xspace_to_tool_data([pb_path], "hlo_stats", {})
+    obj = json.loads(data) if isinstance(data, (str, bytes)) else data
+    cols = [c["id"] for c in obj["cols"]]
+    i = {c: cols.index(c) for c in
+         ("category", "hlo_op_name", "occurrences", "avg_self_time")}
+    rows = []
+    for r in obj["rows"]:
+        v = [c["v"] if isinstance(c, dict) else c for c in r["c"]]
+        rows.append((str(v[i["category"]]), str(v[i["hlo_op_name"]]),
+                     float(v[i["occurrences"]]) * float(v[i["avg_self_time"]])))
+    return rows
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    import paddle_tpu.ops.pallas_attention as pa
+
+    b, t, h, d = 8, 4096, 6, 128
+    rng = np.random.default_rng(0)
+    qp, kp, vp, dop = (jnp.asarray(rng.normal(size=(b, t, h * d)) * 0.3,
+                                   jnp.bfloat16) for _ in range(4))
+    scale = d ** -0.5
+    o, lse = pa._flash_fwd(qp, kp, vp, scale, True, 1024, 1024, False,
+                           n_head=h)
+    lse3 = lse[:, :, None]
+    steps = 6
+
+    for bq, bk in [(1024, 1024), (512, 1024), (1024, 512), (512, 2048),
+                   (2048, 512), (2048, 1024), (1024, 2048)]:
+        try:
+            fn = jax.jit(lambda q, k, v, oo, ll, do, _bq=bq, _bk=bk:
+                         pa._flash_bwd_fused(q, k, v, oo, ll, do, scale,
+                                             True, _bq, _bk, False,
+                                             n_head=h))
+            g = fn(qp, kp, vp, o, lse3, dop)
+            float(jnp.sum(g[0][0, 0].astype(jnp.float32)))
+        except Exception as e:
+            print(f"bq={bq:5d} bk={bk:5d}  REJECTED: "
+                  f"{str(e).splitlines()[0][:90]}")
+            continue
+        td = tempfile.mkdtemp(prefix="bwdblk")
+        with jax.profiler.trace(td):
+            for _ in range(steps):
+                g = fn(qp, kp, vp, o, lse3, dop)
+            float(jnp.sum(g[0][0, 0].astype(jnp.float32)))
+        rows = hlo_times(glob.glob(td + "/**/*.xplane.pb", recursive=True)[0])
+        kern = sum(us for c, _, us in rows if c == "custom-call") / steps
+        red = sum(us for c, _, us in rows
+                  if c in ("reduce", "loop fusion", "convert fusion")) / steps
+        tot = sum(us for _, _, us in rows) / steps
+        print(f"bq={bq:5d} bk={bk:5d}  kernel {kern/1e3:6.3f} ms  "
+              f"reduce-ish {red/1e3:6.3f}  total {tot/1e3:6.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -111,11 +111,15 @@ def run_config(n_servers, mode, steps=30, vocab=100_000, emb=16,
         sparse_bytes = sparse_rows * emb * 4
 
         t0 = time.perf_counter()
-        fetch_bytes = 0
+        # last_step_fetch_bytes lags one step in pipelined mode; the
+        # cumulative counter delta across the timed region (read after
+        # the final flush() lands the last in-flight round trip) is
+        # exact for both modes
+        fetch_total0 = dt.total_fetch_bytes
         for i in range(steps):
             dt.train_step(feeds[i % len(feeds)])
-            fetch_bytes += dt.last_step_fetch_bytes
         dt.flush()
+        fetch_bytes = dt.total_fetch_bytes - fetch_total0
         dtot = time.perf_counter() - t0
         dt.close()
         return {

@@ -1378,8 +1378,24 @@ def evaluator_base(input, type, label=None, weight=None, name=None,
         return sum_evaluator(input)
     if t in ("column_sum", "column_sum_evaluator", "last-column-sum"):
         return column_sum_evaluator(input)
-    # printer family: evaluation-time inspection — fetch the value itself
-    if t.endswith("_printer") or t in ("value_printer", "seq_text_printer"):
+    if t in ("ctc_edit_distance", "ctc_error", "ctc_error_evaluator"):
+        return ctc_error_evaluator(input, label)
+    if t in ("last-column-auc",):
+        return layers.auc(input=input, label=label)
+    if t in ("max_id_printer", "maxid_printer"):
+        return maxid_printer_evaluator(input)
+    if t in ("max_frame_printer", "maxframe_printer"):
+        return maxframe_printer_evaluator(input)
+    # printer family: evaluation-time inspection — fetch the value
+    # itself.  Only types whose reference semantics ARE "print the
+    # input" may fall through; gradient_printer attaches to gradients
+    # (reference evaluators.py:630), which a fetch-the-input shim would
+    # silently misrepresent — reject it instead.
+    if t == "gradient_printer":
+        raise ValueError(
+            "gradient_printer attaches to parameter gradients; fetch "
+            "<param>@GRAD explicitly instead of using the evaluator shim")
+    if t in ("value_printer", "seq_text_printer"):
         return input
     raise ValueError(f"unknown evaluator type {type!r}")
 

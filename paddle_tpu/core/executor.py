@@ -614,7 +614,7 @@ class Executor:
                     f"gradient_accumulation(micro_steps={accum}): feed "
                     f"{n!r} leading dim {b0} is not divisible")
             mbs[n] = b0 // accum
-        full_b = env[feed_names[0]].shape[0] if mbs else 0
+        full_b = env[sorted(mbs)[0]].shape[0] if mbs else 0
 
         fwd_written = {
             n for op in block.ops[:bw] for n in op.output_names()
@@ -652,20 +652,39 @@ class Executor:
         grads = {
             n: (gsum[n] / accum).astype(env[n].dtype) for n in gsum
         }
+        producer = {}
+        for op in block.ops[:bw]:
+            for out_n in op.output_names():
+                producer[out_n] = op
+
+        def _static_batch_leading(name):
+            var = block._find_var(name)
+            vshape = tuple(var.shape) if var is not None else ()
+            return len(vshape) >= 1 and (
+                vshape[0] == -1 or (full_b and vshape[0] == full_b))
+
         aux = dict(persist_f)
         for n, y in ys.items():
             # classify by the var's STATIC leading dim, not the runtime
             # shape (a [1]-shaped mean fetch with microbatch 1 must not be
             # mistaken for batch data): -1 or the full feed batch means
             # batch-leading -> microbatch results concatenate back.
-            var = block._find_var(n)
-            vshape = tuple(var.shape) if var is not None else ()
-            batch_leading = (
-                y.ndim >= 2 and len(vshape) >= 1
-                and (vshape[0] == -1 or (full_b and vshape[0] == full_b))
-            )
-            if batch_leading:
+            if y.ndim >= 2 and _static_batch_leading(n):
                 aux[n] = y.reshape((-1,) + y.shape[2:])
+                continue
+            op = producer.get(n)
+            batch_sum = (
+                op is not None and op.type == "reduce_sum"
+                and any(_static_batch_leading(i_n)
+                        for ns_ in op.inputs.values() for i_n in ns_)
+            )
+            if batch_sum:
+                # a reduction OVER the batch: the big-batch sum is the
+                # sum of the microbatch sums.  (reduce_sum of batch-
+                # independent tensors — weight norms — is microbatch-
+                # invariant and falls through to the mean, which is then
+                # exact.)
+                aux[n] = jnp.sum(y, axis=0)
             elif jnp.issubdtype(y.dtype, jnp.inexact):
                 # scalar metrics (avg loss): mean of equal-weight
                 # microbatch averages == the big-batch average

@@ -124,6 +124,9 @@ class DistributedTrainer:
                            if mode == "pipelined" else None)
         self._pending = None
         self.last_step_fetch_bytes = 0
+        # cumulative counter: exact accounting across pipelined steps
+        # (last_step_fetch_bytes lags one step in pipelined mode)
+        self.total_fetch_bytes = 0
         # per-param prefetch/send fan-out pool (distinct from the
         # client's per-server pool, so nesting cannot deadlock)
         self._sparse_pool = (
@@ -178,6 +181,7 @@ class DistributedTrainer:
         for name, value in fresh.items():
             scope.set(name, value)
         self.last_step_fetch_bytes = nbytes
+        self.total_fetch_bytes += nbytes
 
     def __enter__(self):
         return self
@@ -288,9 +292,11 @@ class DistributedTrainer:
                 for name, value in fresh.items():
                     scope.set(name, value)
                 self.last_step_fetch_bytes = nbytes
+                self.total_fetch_bytes += nbytes
         else:
             fresh, nbytes = _round_trip()
             for name, value in fresh.items():
                 scope.set(name, value)
             self.last_step_fetch_bytes = nbytes
+            self.total_fetch_bytes += nbytes
         return vals[len(self.param_names):]

@@ -65,6 +65,39 @@ def gpt(tokens, vocab_size, n_layer=4, n_head=8, d_model=256, d_ff=None,
     return ltensor.cast(logits, "float32")
 
 
+def tp_rules():
+    """Tensor-parallel sharding rules for the flagship transformer
+    (apply with ``parallel.shard_parameters_by_rule`` on a mesh with a
+    'tp' axis; requires n_head % tp == 0 and vocab % tp == 0):
+
+    - QKV projections column-shard (= whole heads per shard: the packed
+      feature dim is the head dim), so the flash kernel runs via
+      shard_map over local heads with no cross-shard traffic
+      (``flash_attention_packed`` op's tp path);
+    - the attention out-projection and FFN2 row-shard (XLA inserts the
+      one all-reduce per block pair);
+    - FFN1 column-shards;
+    - the LM head vocab-shards — the fused CE head merges shard
+      softmaxes by logsumexp (``fused_softmax_ce_head`` op's tp path),
+      so the [tokens, vocab] logits stay sharded AND off-HBM;
+    - everything else (LN, embeddings, remaining biases) replicates.
+
+    The reference's model parallelism is per-layer device placement
+    (``ParallelNeuralNetwork.cpp:45``); this is the same capability as
+    sharding annotations + compiler collectives instead of threads."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"_att_(q|k|v)\.w$", P(None, "tp")),
+        (r"_att_(q|k|v)\.b$", P("tp")),
+        (r"_att_out\.w$", P("tp", None)),
+        (r"_ffn1\.w$", P(None, "tp")),
+        (r"_ffn1\.b$", P("tp")),
+        (r"_ffn2\.w$", P("tp", None)),
+        (r"^lm_head\.w$", P(None, "tp")),
+    ]
+
+
 def extract_params(scope=None, program=None):
     """Pull the model weights (not optimizer state) out of a scope as the
     name->array dict `generate` consumes."""

@@ -166,19 +166,23 @@ class Loader:
             if err:
                 raise IOError(f"loader: {err.decode()}")
             return None
-        if n < batch_size:
-            # partial batch = end of stream OR a worker died mid-stream;
-            # surface the error now rather than on a next call the
-            # caller may never make
-            err = self._lib.loader_error(self._h)
-            if err:
-                raise IOError(f"loader: {err.decode()}")
         pre = prefix[:n]
         pay = payload[:n]
         if prefix_dtype != "uint8":
             pre = pre.view(prefix_dtype)
         if payload_dtype != "uint8":
             pay = pay.view(payload_dtype)
+        if n < batch_size:
+            # A partial batch may mean end-of-stream OR a worker died
+            # mid-stream.  Surface a pending error NOW (callers often
+            # treat a short batch as clean EOS and never call again),
+            # but don't discard the n good records: they ride on the
+            # exception as ``err.partial``.
+            err = self._lib.loader_error(self._h)
+            if err:
+                e = IOError(f"loader: {err.decode()}")
+                e.partial = (pre, pay)
+                raise e
         return pre, pay
 
     def close(self):

@@ -725,13 +725,44 @@ def flash_attention_op(Q, K, V, causal=False, sm_scale=0.0, **_):
     return {"Out": flash_attention(Q, K, V, causal=causal, sm_scale=scale)}
 
 
+def _tp_axis(_ctx):
+    """(mesh, tp_size) when the executor runs under a mesh with a 'tp'
+    axis — the signal for the ops to enter their shard_map paths."""
+    mesh = getattr(getattr(_ctx, "executor", None), "mesh", None)
+    if mesh is None or "tp" not in mesh.axis_names:
+        return None, 1
+    return mesh, int(mesh.shape["tp"])
+
+
 @register_op("flash_attention_packed")
 def flash_attention_packed_op(Q, K, V, n_head=None, causal=False,
-                              sm_scale=0.0, **_):
+                              sm_scale=0.0, _ctx=None, **_):
     if n_head is None:
         # no safe default: 1 would silently softmax across the whole
         # concatenated h*d feature dim as a single head
         raise ValueError("flash_attention_packed op requires the n_head attr")
+    n_head = int(n_head)
     scale = None if not sm_scale else float(sm_scale)
+    mesh, tp = _tp_axis(_ctx)
+    if tp > 1 and n_head % tp == 0:
+        # Head-sharded tensor parallelism: the packed feature dim IS the
+        # head dim, so a 'tp' shard of [b, t, h*d] holds h/tp whole
+        # heads and attention needs NO cross-shard communication — each
+        # shard runs the kernel on its local heads (the shard_map-over-
+        # heads recipe; GSPMD cannot partition an opaque custom call, so
+        # without this it would all-gather the tp-sharded activations).
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        db = "dp" if "dp" in mesh.axis_names else None
+        spec = P(db, None, "tp")
+
+        def local(q, k, v):
+            return flash_attention_packed(
+                q, k, v, n_head // tp, causal=causal, sm_scale=scale)
+
+        out = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_rep=False)(Q, K, V)
+        return {"Out": out}
     return {"Out": flash_attention_packed(
-        Q, K, V, int(n_head), causal=causal, sm_scale=scale)}
+        Q, K, V, n_head, causal=causal, sm_scale=scale)}

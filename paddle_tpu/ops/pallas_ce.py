@@ -9,8 +9,9 @@ traffic.  This kernel fuses projection -> log-softmax -> NLL the flash
 way: the vocab axis is tiled, logit tiles live only in VMEM, an online
 max/sum carries the softmax state across vocab tiles, and the label's
 logit is picked up by an iota==label select in the visited tile.  HBM
-residual is O(tokens) (the lane-replicated lse rows), never O(tokens x
-vocab).
+residual is O(tokens) — one f32 lse per token, stored compactly (narrow
+[n, 1] kernel output, squeezed to 1-D; same convention as
+pallas_attention.py) — never O(tokens x vocab).
 
 Backward mirrors flash: two Pallas kernels recompute the probability
 tiles from the saved lse — dx (row-major grid, vocab innermost,
@@ -236,13 +237,51 @@ def _ce_core_bwd(blocks, interpret, res, g):
 _ce_core.defvjp(_ce_core_fwd, _ce_core_bwd)
 
 
-def fused_softmax_ce_head(x, w, labels, block_n=512, block_v=1024,
-                          interpret=None, block_v_fwd=2048):
-    """Fused projection + softmax cross-entropy: ``x [..., d]``,
-    ``w [d, v]``, ``labels [...]`` int -> per-position NLL ``[...]`` f32,
-    without ever materializing ``[..., v]`` logits in HBM.
-    Differentiable in x and w (custom VJP).  ``interpret=None``
-    auto-selects Pallas interpreter mode off-TPU (CPU tests)."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ce_core_lse(x, w, y, blocks, interpret):
+    """Like ``_ce_core`` but also returns the per-row lse, DIFFERENTIABLE
+    through both outputs — the building block for vocab-sharded tensor
+    parallelism, where each shard's (loss_s, lse_s) pair is merged by a
+    cross-shard logsumexp (same pattern as flash_attention_with_lse for
+    ring attention)."""
+    return _ce_fwd(x, w, y, blocks[0], blocks[2], interpret)
+
+
+def _ce_core_lse_fwd(x, w, y, blocks, interpret):
+    loss, lse = _ce_fwd(x, w, y, blocks[0], blocks[2], interpret)
+    return (loss, lse), (x, w, y, lse)
+
+
+def _ce_core_lse_bwd(blocks, interpret, res, cts):
+    x, w, y, lse = res
+    g, glse = cts
+    # loss = lse - picked, so with an extra lse cotangent glse the total
+    # logits cotangent is (g + glse)*(p - onehot) + glse*onehot: the
+    # first term is exactly the existing backward kernels run with
+    # g' = g + glse; the onehot term is a rank-1-per-row correction
+    # (dx += glse * W[:, y],  dW[:, y] += glse * x) done in plain JAX.
+    g = g.astype(jnp.float32)
+    glse = glse.astype(jnp.float32)
+    dx, dw = _ce_bwd(x, w, y, lse, g + glse, blocks[0], blocks[1],
+                     interpret)
+    yi = y.astype(jnp.int32)
+    dx = dx + (glse[:, None] * w[:, yi].T).astype(dx.dtype)
+    dw = dw + (jnp.zeros(dw.shape, jnp.float32)
+               .at[:, yi].add(x.T.astype(jnp.float32) * glse[None, :])
+               ).astype(dw.dtype)
+    return dx, dw, np.zeros(y.shape, jax.dtypes.float0)
+
+
+_ce_core_lse.defvjp(_ce_core_lse_fwd, _ce_core_lse_bwd)
+
+
+def fused_softmax_ce_head_with_lse(x, w, labels, block_n=512,
+                                   block_v=1024, interpret=None,
+                                   block_v_fwd=2048):
+    """``fused_softmax_ce_head`` that ALSO returns the per-position lse
+    (both ``[...]`` f32), differentiable through both — callers compose
+    partial losses across vocab shards with a logsumexp merge
+    (parallelism: see the fused_softmax_ce_head op's tp path)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     lead = x.shape[:-1]
@@ -250,13 +289,100 @@ def fused_softmax_ce_head(x, w, labels, block_n=512, block_v=1024,
     n = 1
     for s in lead:
         n *= int(s)
-    # the forward fits a wider vocab block than the backward kernels
-    # (whose dx/dw accumulators + second input block hit the 16 MB
-    # scoped-VMEM limit at bv=2048); measured fwd 10.8 -> 9.7 ms at the
-    # flagship shape with the split sizes
+    bn, bv, bv_fwd = _auto_blocks(
+        n, d, w.shape[1], x.dtype.itemsize, w.dtype.itemsize,
+        int(block_n), int(block_v), int(block_v_fwd))
+    loss, lse = _ce_core_lse(
+        x.reshape(n, d), w, labels.reshape(n).astype(jnp.int32),
+        (bn, bv, bv_fwd), bool(interpret))
+    return loss.reshape(lead), lse.reshape(lead)
+
+
+# per-kernel VMEM budget for the block chooser.  14 MB (of the 16 MB
+# scoped limit) reproduces the hand-tuned flagship config exactly
+# (bn=512, bv=1024, bv_fwd=2048 at d=768 bf16) while leaving headroom
+# for Mosaic's own spills; larger d_model configs shrink to fit instead
+# of dying in the Mosaic lowering with a raw VMEM-OOM.
+VMEM_BUDGET = 14 << 20
+
+
+def _vmem_est(kernel, bn, bv, d, ix, iw):
+    """Rough per-grid-cell VMEM bytes: double-buffered input blocks +
+    the f32 logits tile + kernel-specific accumulators/outputs."""
+    inputs = 2 * (bn * d * ix + d * bv * iw)
+    s_tile = bn * bv * 4
+    if kernel == "fwd":
+        extra = 3 * bn * LANES * 4
+    elif kernel == "dx":
+        extra = bn * d * 4 + bn * d * ix
+    else:  # dw
+        extra = d * bv * 4 + d * bv * iw
+    return inputs + s_tile + extra
+
+
+def _auto_blocks(n, d, v, ix, iw, block_n, block_v, block_v_fwd,
+                 budget=None):
+    """Shrink the (caller-capped) block sizes until every kernel's VMEM
+    estimate fits the scoped budget.  Raises with an actionable message
+    if even the minimum blocks cannot fit (enormous d_model)."""
+    budget = budget or VMEM_BUDGET
+
+    def fit(kernel, bn_cap, bv_cap):
+        bn = _pick_block(n, bn_cap)
+        bv_c = bv_cap
+        while True:
+            bv = _pick_block(v, bv_c)
+            if _vmem_est(kernel, bn, bv, d, ix, iw) <= budget:
+                return bn, bv
+            if bv_c > 128:
+                bv_c //= 2
+                continue
+            if bn > 8:
+                bn = _pick_block(n, max(8, bn // 2))
+                bv_c = bv_cap
+                continue
+            raise ValueError(
+                f"fused_softmax_ce_head: no block config fits VMEM for "
+                f"d_model={d}, vocab={v} ({kernel} kernel needs "
+                f"{_vmem_est(kernel, bn, bv, d, ix, iw) >> 20} MB at the "
+                f"minimum blocks, budget {budget >> 20} MB) — use the "
+                f"unfused softmax_with_cross_entropy head for this shape")
+
+    bn_f, bv_f = fit("fwd", block_n, block_v_fwd)
+    bn_x, bv_x = fit("dx", block_n, block_v)
+    bn_w, bv_w = fit("dw", block_n, block_v)
+    # one bn for all kernels (the residual/stat blocks are shared)
+    bn = min(bn_f, bn_x, bn_w)
+    return bn, min(bv_x, bv_w), bv_f
+
+
+def fused_softmax_ce_head(x, w, labels, block_n=512, block_v=1024,
+                          interpret=None, block_v_fwd=2048):
+    """Fused projection + softmax cross-entropy: ``x [..., d]``,
+    ``w [d, v]``, ``labels [...]`` int -> per-position NLL ``[...]`` f32,
+    without ever materializing ``[..., v]`` logits in HBM.
+    Differentiable in x and w (custom VJP).  ``interpret=None``
+    auto-selects Pallas interpreter mode off-TPU (CPU tests).
+
+    Block args are UPPER bounds: the chooser shrinks them per kernel to
+    fit scoped VMEM (the forward fits a wider vocab block than the
+    backward kernels, whose accumulators + second input block OOM at
+    bv=2048/d=768; measured fwd 10.8 -> 9.7 ms at the flagship shape
+    with the split sizes), so d_model >= 1024 configs work instead of
+    hitting a raw Mosaic VMEM error."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    n = 1
+    for s in lead:
+        n *= int(s)
+    bn, bv, bv_fwd = _auto_blocks(
+        n, d, w.shape[1], x.dtype.itemsize, w.dtype.itemsize,
+        int(block_n), int(block_v), int(block_v_fwd))
     loss = _ce_core(
         x.reshape(n, d), w, labels.reshape(n).astype(jnp.int32),
-        (int(block_n), int(block_v), int(block_v_fwd)), bool(interpret))
+        (bn, bv, bv_fwd), bool(interpret))
     return loss.reshape(lead)
 
 
@@ -271,10 +397,53 @@ def fused_softmax_ce_head_reference(x, w, labels):
 
 @register_op("fused_softmax_ce_head")
 def fused_softmax_ce_head_op(X, W, Label, block_n=512, block_v=1024,
-                             block_v_fwd=2048, **_):
+                             block_v_fwd=2048, _ctx=None, **_):
     lbl = Label
     if lbl.ndim == X.ndim and lbl.shape[-1] == 1:
         lbl = lbl.reshape(lbl.shape[:-1])
+    from .pallas_attention import _tp_axis
+
+    mesh, tp = _tp_axis(_ctx)
+    v = W.shape[1]
+    if tp > 1 and v % tp == 0:
+        # Vocab-sharded tensor parallelism: each shard runs the fused
+        # kernel over its vocab slice (labels localized by the shard
+        # offset) and the global softmax is recovered by a cross-shard
+        # logsumexp merge — the same online-softmax algebra the kernel
+        # uses across vocab TILES, lifted to mesh shards:
+        #   lse  = logsumexp_tp(lse_s)
+        #   loss = lse - psum(in_shard ? (lse_s - loss_s) : 0)
+        # Differentiable end to end (loss_s/lse_s carry the kernel's
+        # custom VJP; the merge is plain JAX).
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        db = "dp" if "dp" in mesh.axis_names else None
+        xspec = P(*([db] + [None] * (X.ndim - 1)))
+        lspec = P(*([db] + [None] * (lbl.ndim - 1)))
+
+        def local(x, w, y):
+            vs = w.shape[1]
+            off = jax.lax.axis_index("tp") * vs
+            y = y.astype(jnp.int32)
+            in_s = ((y >= off) & (y < off + vs))
+            y_loc = jnp.clip(y - off, 0, vs - 1)
+            loss_s, lse_s = fused_softmax_ce_head_with_lse(
+                x, w, y_loc, block_n=block_n, block_v=block_v,
+                block_v_fwd=block_v_fwd)
+            picked = jnp.where(in_s, lse_s - loss_s, 0.0)
+            # the max shift is numerical stabilization only (it cancels
+            # algebraically) — stop_gradient keeps the merge on psum's
+            # differentiation path (pmax has no JVP rule)
+            m = jax.lax.pmax(jax.lax.stop_gradient(lse_s), "tp")
+            lse = jnp.log(jax.lax.psum(jnp.exp(lse_s - m), "tp")) + m
+            return lse - jax.lax.psum(picked, "tp")
+
+        loss = shard_map(
+            local, mesh=mesh,
+            in_specs=(xspec, P(None, "tp"), lspec),
+            out_specs=lspec, check_rep=False)(X, W, lbl)
+        return {"Loss": loss[..., None]}
     loss = fused_softmax_ce_head(X, W, lbl, block_n=block_n,
                                  block_v=block_v,
                                  block_v_fwd=block_v_fwd)

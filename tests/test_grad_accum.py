@@ -167,3 +167,43 @@ def test_accum_bn_stats_thread_through_microbatches():
     for (n1, a), (n2, b) in zip(st1, st2):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
                                    err_msg=f"{n1} vs {n2}")
+
+
+def test_accum_sum_and_composite_metrics_not_inflated():
+    """Regression (round-5 review): a fetched reduce_sum OVER the batch
+    must SUM across microbatches; a composite scalar built from means
+    (layers.sums of two mean costs) must NOT be multiplied by accum."""
+
+    def build():
+        x = pt.layers.data("x", shape=[4], dtype="float32")
+        lbl = pt.layers.data("y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, 1)
+        sq = pt.layers.square_error_cost(pred, lbl)
+        batch_sum = pt.layers.reduce_sum(sq)        # sums over the batch
+        m = pt.layers.mean(sq)
+        twice = pt.layers.sums([m, m])              # composite of means
+        pt.optimizer.SGD(learning_rate=0.0).minimize(m)
+        return batch_sum, m, twice
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = rng.normal(size=(8, 1)).astype(np.float32)
+
+    def run(accum):
+        pt.core.unique_name.reset()
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 3
+        with pt.program_guard(main, startup):
+            fetches = build()
+        if accum > 1:
+            pt.gradient_accumulation(main, accum)
+        scope = pt.core.scope.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        vals = exe.run(main, feed={"x": x, "y": y},
+                       fetch_list=list(fetches), scope=scope)
+        return [float(np.asarray(v).sum()) for v in vals]
+
+    ref = run(1)
+    got = run(2)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
