@@ -33,6 +33,7 @@ def bench_resnet_infer(batch=16, steps=20, warmup=3, repeats=5):
     import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.models import resnet
+    from bench import timed_steps  # one timing discipline for all benches
 
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
@@ -41,19 +42,11 @@ def bench_resnet_infer(batch=16, steps=20, warmup=3, repeats=5):
     exe = pt.Executor()
     exe.run(startup)
     img = jnp.asarray(np.random.rand(batch, 3, 224, 224), jnp.bfloat16)
-    label = jnp.asarray(np.zeros((batch, 1)), jnp.int64)
+    label = jnp.asarray(np.zeros((batch, 1), np.int32))
     feed = {"img": img, "label": label}
-    fetch = [outs["prediction"]]
-    for _ in range(warmup):
-        exe.run(main_prog, feed=feed, fetch_list=fetch)
-    rates = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            pred = exe.run(main_prog, feed=feed, fetch_list=fetch,
-                           return_numpy=False)
-        np.asarray(pred[0])  # host materialization = honest sync
-        rates.append(batch * steps / (time.perf_counter() - t0))
+    _, times, _ = timed_steps(exe, main_prog, feed, [outs["prediction"]],
+                              steps, warmup, repeats=repeats)
+    rates = [batch * steps / t for t in times]
     return float(np.median(rates)), min(rates), max(rates)
 
 
@@ -78,15 +71,19 @@ def bench_gpt_decode(batch=16, prompt_len=16, max_len=512, repeats=5):
 
     prompt = np.random.randint(1, vocab, (batch, prompt_len)).astype(np.int32)
 
+    # serving config: tokens only (skip stacking ~1 GB of per-step
+    # logits), weights/cache in their native bf16 (decode is HBM-bound
+    # on weight reads; bf16 halves them)
     gen = jax.jit(lambda pr: transformer.generate(
-        params, pr, max_len, n_layer, n_head, d_model))
-    toks, _ = gen(prompt)  # compile
+        params, pr, max_len, n_layer, n_head, d_model,
+        return_logits=False)[0])
+    toks = gen(prompt)  # compile
     np.asarray(toks)
     new_tokens = batch * (max_len - prompt_len)
     rates = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        toks, _ = gen(prompt)
+        toks = gen(prompt)
         np.asarray(toks)
         rates.append(new_tokens / (time.perf_counter() - t0))
     return float(np.median(rates)), min(rates), max(rates)

@@ -74,7 +74,7 @@ def main():
     # --- baseline: device-resident synthetic ---
     img = jnp.asarray(np.random.rand(args.batch, *shape), jnp.bfloat16)
     lbl = jnp.asarray(np.random.randint(0, 1000, (args.batch, 1)), jnp.int32)
-    dt, _ = timed_steps(exe, main_prog, {"img": img, "label": lbl},
+    dt, _, _ = timed_steps(exe, main_prog, {"img": img, "label": lbl},
                         fetch, args.steps, 3)
     synth = args.batch * args.steps / dt
     print(f"synthetic: {synth:8.1f} img/s")

@@ -153,7 +153,7 @@ def bench_one(name, steps, warmup, dtype):
         rng = np.random.default_rng(0)
         feed = _feed(name, cfg, dtype, rng)
         fetch = [outs["avg_cost"]]
-        dt, cost = timed_steps(exe, main, feed, fetch, steps, warmup)
+        dt, _, cost = timed_steps(exe, main, feed, fetch, steps, warmup)
     assert np.isfinite(cost[0]).all()
     ms = dt / steps * 1000.0
     return {

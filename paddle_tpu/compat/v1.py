@@ -111,12 +111,23 @@ def _pool_name(pooling_type, default="max"):
 
 # ------------------------------------------------------------------- layers
 def data_layer(name, size, height=None, width=None, depth=None, dtype=None,
-               is_label=False, seq_len=None, **_):
+               is_label=False, seq_len=None, sparse=False, **_):
     """v1 data_layer(size=...) -> layers.data.  Static shapes are an XLA
     requirement, so the ragged v1 slots take explicit extents here:
     image inputs pass height/width (channels inferred from size); integer
     id-sequence inputs pass dtype='int64' + seq_len (size then means
-    vocabulary, stashed for embedding_layer); labels use is_label=True."""
+    vocabulary, stashed for embedding_layer); labels use is_label=True.
+    ``sparse=True`` declares the slot as a native sparse input (the
+    provider's sparse_binary/float_vector types): fc on it lowers to the
+    O(nnz) weighted gather-sum and the slot feeds as @IDS/@VALS arrays —
+    a 10M-dim CTR slot never materializes densely."""
+    if sparse:
+        # seq_len marks a sparse_*_vector_sequence slot: the shadow
+        # arrays gain a time axis and @LENGTH carries sequence lengths
+        var = layers.sparse_data(
+            name, dim=size, lod_level=1 if seq_len is not None else 0)
+        var._v1_vocab = size
+        return var
     if height and width:
         channels = size // (height * width)
         shape = [channels, height, width]

@@ -664,6 +664,47 @@ class Executor:
                 vshape[0] == -1 or (full_b and vshape[0] == full_b))
 
         aux = dict(persist_f)
+
+        # additive combiners through which batch-sum-ness propagates
+        # linearly: sum(microbatch values) reassembles the big-batch value
+        _ADDITIVE = {"elementwise_add", "elementwise_sub", "sum", "sums",
+                     "scale"}
+
+        def _is_batch_sum(name, _depth=0):
+            """Transitive classification: True when the fetch is a pure
+            batch-reduction sum (directly a reduce_sum over batch data, or
+            an additive composite of such), so the big-batch value is the
+            SUM of the microbatch values.  A composite mixing sum-like and
+            non-sum-like terms has no exact reassembly — raise rather than
+            silently return 1/accum of the truth."""
+            if _depth > 64:
+                return False
+            op = producer.get(name)
+            if op is None:
+                return False
+            ins = [i_n for ns_ in op.inputs.values() for i_n in ns_]
+            if op.type == "reduce_sum":
+                return any(_static_batch_leading(i) for i in ins) or all(
+                    _is_batch_sum(i, _depth + 1) for i in ins)
+            if op.type in _ADDITIVE:
+                flags = [_is_batch_sum(i, _depth + 1) for i in ins]
+                if op.type == "scale" and any(flags) and (
+                        float(op.attrs.get("bias", 0.0)) != 0.0):
+                    # X*s + b over a batch sum: summing microbatch
+                    # values would inflate the bias term accum-fold
+                    raise ValueError(
+                        f"gradient_accumulation cannot reassemble fetch "
+                        f"{name!r}: scale with a nonzero bias over a "
+                        f"batch-sum term; apply the bias on the host")
+                if any(flags) and not all(flags):
+                    raise ValueError(
+                        f"gradient_accumulation cannot reassemble fetch "
+                        f"{name!r}: it mixes batch-sum terms with "
+                        f"non-sum terms (op {op.type!r}); fetch the "
+                        f"parts separately and combine on the host")
+                return all(flags) and bool(flags)
+            return False
+
         for n, y in ys.items():
             # classify by the var's STATIC leading dim, not the runtime
             # shape (a [1]-shaped mean fetch with microbatch 1 must not be
@@ -672,13 +713,7 @@ class Executor:
             if y.ndim >= 2 and _static_batch_leading(n):
                 aux[n] = y.reshape((-1,) + y.shape[2:])
                 continue
-            op = producer.get(n)
-            batch_sum = (
-                op is not None and op.type == "reduce_sum"
-                and any(_static_batch_leading(i_n)
-                        for ns_ in op.inputs.values() for i_n in ns_)
-            )
-            if batch_sum:
+            if _is_batch_sum(n):
                 # a reduction OVER the batch: the big-batch sum is the
                 # sum of the microbatch sums.  (reduce_sum of batch-
                 # independent tensors — weight norms — is microbatch-

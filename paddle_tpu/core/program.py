@@ -34,6 +34,10 @@ from .dtypes import convert_dtype
 LENGTH_SUFFIX = "@LENGTH"
 SUBLENGTH_SUFFIX = "@SUBLENGTH"
 GRAD_SUFFIX = "@GRAD"
+# sparse input slots feed as two shadow arrays (ids + weights) — the
+# no-densify path for reference sparse_binary/float_vector inputs
+IDS_SUFFIX = "@IDS"
+VALS_SUFFIX = "@VALS"
 
 
 class Variable:

@@ -9,7 +9,9 @@ same information as LoD, static shapes.
 
 import numpy as np
 
-from .core.program import LENGTH_SUFFIX, SUBLENGTH_SUFFIX
+from .core.program import (IDS_SUFFIX, LENGTH_SUFFIX, SUBLENGTH_SUFFIX,
+                           VALS_SUFFIX)
+from .reader.provider import SparseRow
 
 
 def _round_up(n, m):
@@ -30,6 +32,19 @@ class DataFeeder:
         result = {}
         for i, var in enumerate(self.feed_vars):
             col = [row[i] for row in rows]
+            if getattr(var, "sparse_slot", False):
+                self._feed_sparse(var, col, result)
+                continue
+            # sparse provider slot feeding a DENSE var: densify (the
+            # small-dim compatibility path; declare the var with
+            # layers.sparse_data to stay sparse).  Sequence slots (cells
+            # are lists of SparseRow) densify to [t, dim] rows and fall
+            # through to the normal lod padding below.
+            if col and isinstance(col[0], SparseRow):
+                col = [c.todense() for c in col]
+            elif (col and isinstance(col[0], (list, tuple)) and col[0]
+                  and isinstance(col[0][0], SparseRow)):
+                col = [np.stack([r.todense() for r in c]) for c in col]
             if getattr(var, "lod_level", 0) > 1:
                 self._feed_nested(var, col, result)
             elif getattr(var, "lod_level", 0) > 0:
@@ -37,8 +52,13 @@ class DataFeeder:
                 lens = np.asarray([a.shape[0] for a in arrs], dtype=np.int32)
                 max_len = max(1, _round_up(int(lens.max()), self.pad_multiple))
                 feat = arrs[0].shape[1:]
-                # honor a declared static time dim if the var has one
-                declared = var.shape[1] if len(var.shape) > 1 else -1
+                # honor a declared static time dim — but only when the
+                # declared rank actually covers [b, t, *feat]; a
+                # feature-only declaration (shape=[d], lod_level=1) must
+                # not have its feature dim misread as the time cap (same
+                # guard as _feed_nested)
+                declared = (var.shape[1]
+                            if len(var.shape) == 2 + len(feat) else -1)
                 if declared and declared > 0:
                     max_len = declared
                 out = np.zeros((len(arrs), max_len) + feat, dtype=var.dtype)
@@ -58,6 +78,36 @@ class DataFeeder:
                     arr = arr[..., None]  # fluid's trailing [.,1] label shape
                 result[var.name] = arr
         return result
+
+    def _feed_sparse(self, var, col, result):
+        """Native sparse slot: pad each sample's (ids, vals) to the batch
+        max nnz (bucketed by ``pad_multiple`` so the compile cache sees few
+        distinct shapes) and emit ``@IDS``/``@VALS``.  Index 0 with value
+        0.0 as padding keeps the sparse_fc weighted sum exact.  Sequence
+        slots (lod_level=1: each cell a list of SparseRow) pad to
+        [b, t_max, nnz_max] and fill ``@LENGTH``."""
+        if getattr(var, "lod_level", 0) > 0:
+            lens = np.asarray([len(c) for c in col], np.int32)
+            max_t = max(1, _round_up(int(lens.max()), self.pad_multiple))
+            nnz = max([1] + [r.nnz for c in col for r in c])
+            nnz = _round_up(nnz, self.pad_multiple)
+            ids = np.zeros((len(col), max_t, nnz), np.int64)
+            vals = np.zeros((len(col), max_t, nnz), np.float32)
+            for j, c in enumerate(col):
+                for k, r in enumerate(c[:max_t]):
+                    ids[j, k, : r.nnz] = r.ids
+                    vals[j, k, : r.nnz] = r.vals
+            result[var.name + LENGTH_SUFFIX] = np.minimum(lens, max_t)
+        else:
+            nnz = max(1, _round_up(max(c.nnz for c in col),
+                                   self.pad_multiple))
+            ids = np.zeros((len(col), nnz), np.int64)
+            vals = np.zeros((len(col), nnz), np.float32)
+            for j, c in enumerate(col):
+                ids[j, : c.nnz] = c.ids
+                vals[j, : c.nnz] = c.vals
+        result[var.name + IDS_SUFFIX] = ids
+        result[var.name + VALS_SUFFIX] = vals.astype(var.dtype)
 
     def _feed_nested(self, var, col, result):
         """2-level (nested) rows: each sample is a list of sub-sequences,

@@ -1,4 +1,4 @@
-from .io import data
+from .io import data, sparse_data
 from .tensor import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

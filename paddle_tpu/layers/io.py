@@ -1,6 +1,7 @@
 """Data layers (reference: fluid/layers/io.py ``data``)."""
 
-from ..core.program import default_main_program, default_startup_program
+from ..core.program import (IDS_SUFFIX, VALS_SUFFIX, default_main_program,
+                            default_startup_program)
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -33,4 +34,43 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         var.length_var()
     if lod_level > 1:
         var.sub_length_var()
+    return var
+
+
+def sparse_data(name, dim, dtype="float32", lod_level=0, main_program=None):
+    """Declare a NATIVE sparse input slot of vocabulary size ``dim``
+    (reference ``sparse_binary_vector``/``sparse_float_vector`` slots,
+    PyDataProvider2.py:90-156, assembled as sparse Arguments by
+    PyDataProvider2.cpp:195 — never densified).
+
+    TPU re-design: the slot feeds as two padded shadow arrays —
+    ``<name>@IDS`` int64 [b, nnz] (0-padded) and ``<name>@VALS``
+    [b, nnz] (0.0-padded; all-ones for binary slots) — and ``fc`` on the
+    returned handle lowers to the ``sparse_fc`` op, a weighted
+    gather-sum ``sum_i vals_i * W[ids_i]`` whose cost is O(nnz), not
+    O(dim).  Zero-valued padding makes the sum exact without a count.
+    The handle variable itself (declared shape [-1, dim]) is symbolic:
+    it is never fed and never materialized.
+
+    ``lod_level=1`` declares a sequence of sparse vectors: the shadow
+    arrays gain a time axis ([b, t, nnz]) and ``<name>@LENGTH`` carries
+    the sequence lengths as usual.
+    """
+    prog = main_program or default_main_program()
+    shape = [-1, int(dim)]
+    if lod_level:
+        shape = [-1, -1, int(dim)]
+    block = prog.global_block()
+    var = block.create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        is_data=True, stop_gradient=True,
+    )
+    var.sparse_slot = True
+    inner = [-1, -1] if lod_level else [-1]
+    block.create_var(name=name + IDS_SUFFIX, shape=inner + [-1],
+                     dtype="int64", is_data=True, stop_gradient=True)
+    block.create_var(name=name + VALS_SUFFIX, shape=inner + [-1],
+                     dtype=dtype, is_data=True, stop_gradient=True)
+    if lod_level > 0:
+        var.length_var()
     return var

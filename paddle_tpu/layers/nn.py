@@ -5,7 +5,7 @@ wire the shadow ``@LENGTH`` variables automatically (the LoD replacement)."""
 
 import numpy as np
 
-from ..core.program import Variable
+from ..core.program import IDS_SUFFIX, VALS_SUFFIX, Variable
 from ..param_attr import ParamAttr
 from .. import initializer as init_mod
 from .layer_helper import LayerHelper, seq_length
@@ -127,11 +127,31 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     inputs = input if isinstance(input, (list, tuple)) else [input]
     mul_results = []
     for i, x in enumerate(inputs):
+        suffix = "w" if len(inputs) == 1 else f"w_{i}"
+        if getattr(x, "sparse_slot", False):
+            # native sparse input slot: weighted gather-sum, O(nnz) not
+            # O(dim) — the fc-over-sparse-Argument path (layers.sparse_data)
+            w = helper.create_parameter(
+                param_attr, shape=[x.shape[-1], size], dtype=x.dtype,
+                suffix=suffix,
+            )
+            out_shape = list(x.shape[:-1]) + [size]
+            tmp = helper.create_tmp_variable(
+                x.dtype, out_shape, lod_level=x.lod_level)
+            helper.append_op(
+                type="sparse_fc",
+                inputs={"Ids": [x.name + IDS_SUFFIX],
+                        "Vals": [x.name + VALS_SUFFIX], "W": [w.name]},
+                outputs={"Out": [tmp.name]},
+            )
+            _link_length(tmp, x)
+            mul_results.append(tmp)
+            continue
         in_dim = int(np.prod(x.shape[num_flatten_dims:]))
         # one weight per input (duplicable W slot); w_0, w_1... when several
         w = helper.create_parameter(
             param_attr, shape=[in_dim, size], dtype=x.dtype,
-            suffix="w" if len(inputs) == 1 else f"w_{i}",
+            suffix=suffix,
         )
         out_shape = list(x.shape[:num_flatten_dims]) + [size]
         tmp = helper.create_tmp_variable(x.dtype, out_shape, lod_level=x.lod_level)

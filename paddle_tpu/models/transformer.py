@@ -118,7 +118,8 @@ def extract_params(scope=None, program=None):
 
 
 def generate(params, prompt, max_len, n_layer, n_head, d_model,
-             temperature=0.0, key=None, eps=1e-5):
+             temperature=0.0, key=None, eps=1e-5, compute_dtype=None,
+             return_logits=True):
     """Jitted autoregressive decoding with a KV cache (pure-JAX serving
     path over the trained Program parameters — train with the Program,
     serve with `jax.jit(generate)`-style incremental decode; the analog
@@ -133,16 +134,29 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
     temperature  0.0 = greedy argmax; otherwise softmax sampling
              (``key`` required).
 
+    compute_dtype  matmul/cache dtype.  Default: the params' own dtype —
+             bf16-trained weights decode in bf16 (the serving win:
+             decode is HBM-bandwidth-bound on weight reads, and bf16
+             halves them).  LayerNorm statistics, softmax and the
+             emitted logits stay float32 regardless.
+    return_logits  False skips stacking the per-step [batch, vocab]
+             logits (for max_len=512/vocab=32k that is ~1 GB of scan
+             output) — the serving path that only needs tokens.
+
     Returns ``(tokens, logits)``: tokens [batch, max_len] int32 (prompt
     prefix included verbatim), logits [batch, max_len, vocab] float32
-    (position t's next-token distribution).
+    (position t's next-token distribution; ``None`` when
+    ``return_logits=False``).
     """
     import jax
     import jax.numpy as jnp
 
     if temperature and key is None:
         raise ValueError("temperature > 0 sampling requires a PRNG `key`")
-    p = {k: jnp.asarray(v, jnp.float32) for k, v in params.items()}
+    if compute_dtype is None:
+        compute_dtype = jnp.result_type(*(jnp.asarray(v).dtype
+                                          for v in params.values()))
+    p = {k: jnp.asarray(v, compute_dtype) for k, v in params.items()}
     b, p_len = prompt.shape
     dh = d_model // n_head
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -155,52 +169,68 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
             f"table ({table_len} positions)")
     pos_emb = p["pos_emb.w.w"][:max_len]
 
-    def ln(x, name):
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        xn = (x - mu) / jnp.sqrt(var + eps)
-        return xn * p[f"{name}.scale"] + p[f"{name}.bias"]
+    def ln(x, scale, bias):
+        # statistics in f32 even under bf16 compute (mean/var cancellation)
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        xn = ((x32 - mu) / jnp.sqrt(var + eps)).astype(x.dtype)
+        return xn * scale + bias
 
-    def step_logits(tok, t, cache):
-        """One token [b] at position t -> (logits [b, vocab], cache')."""
-        x = p["tok_emb.w"][tok] + pos_emb[t]          # [b, d]
-        for i in range(n_layer):
-            h = ln(x, f"block{i}_ln1")
-            q = (h @ p[f"block{i}_att_q.w"] + p[f"block{i}_att_q.b"])
-            k = (h @ p[f"block{i}_att_k.w"] + p[f"block{i}_att_k.b"])
-            v = (h @ p[f"block{i}_att_v.w"] + p[f"block{i}_att_v.b"])
+    # Per-layer weights stacked [L, ...] so the block stack is ONE
+    # lax.scan over layers, not n_layer inlined copies — the compiled
+    # step graph stays layer-count-independent (a 12L/512-step decode
+    # otherwise emits an HLO big enough to time out compile services).
+    _BLK = ("ln1.scale", "ln1.bias", "att_q.w", "att_q.b", "att_k.w",
+            "att_k.b", "att_v.w", "att_v.b", "att_out.w", "att_out.b",
+            "ln2.scale", "ln2.bias", "ffn1.w", "ffn1.b", "ffn2.w",
+            "ffn2.b")
+    blk = {name: jnp.stack([p[f"block{i}_{name}"] for i in range(n_layer)])
+           for name in _BLK}
+
+    def step_logits(tok, t, cache_k, cache_v):
+        """One token [b] at position t -> (logits [b, vocab], caches').
+        cache_k/cache_v: [L, b, T, h, dh]."""
+
+        def layer(x, wl):
+            w, ck, cv = wl
+            h = ln(x, w["ln1.scale"], w["ln1.bias"])
+            q = h @ w["att_q.w"] + w["att_q.b"]
+            k = h @ w["att_k.w"] + w["att_k.b"]
+            v = h @ w["att_v.w"] + w["att_v.b"]
             qh = q.reshape(b, n_head, dh)
             kh = k.reshape(b, n_head, dh)
             vh = v.reshape(b, n_head, dh)
-            ck = jax.lax.dynamic_update_index_in_dim(
-                cache[f"k{i}"], kh, t, axis=1)          # [b, T, h, dh]
-            cv = jax.lax.dynamic_update_index_in_dim(
-                cache[f"v{i}"], vh, t, axis=1)
-            cache = dict(cache, **{f"k{i}": ck, f"v{i}": cv})
-            s = jnp.einsum("bhd,bThd->bhT", qh, ck) / jnp.sqrt(float(dh))
+            ck = jax.lax.dynamic_update_index_in_dim(ck, kh, t, axis=1)
+            cv = jax.lax.dynamic_update_index_in_dim(cv, vh, t, axis=1)
+            s = jnp.einsum("bhd,bThd->bhT", qh, ck,
+                           preferred_element_type=jnp.float32)
+            s = s / jnp.sqrt(float(dh))
             mask = jnp.arange(max_len)[None, None, :] <= t
             s = jnp.where(mask, s, -1e30)
-            a = jax.nn.softmax(s, axis=-1)
+            a = jax.nn.softmax(s, axis=-1).astype(ck.dtype)
             ctx = jnp.einsum("bhT,bThd->bhd", a, cv).reshape(b, d_model)
-            att = ctx @ p[f"block{i}_att_out.w"] + p[f"block{i}_att_out.b"]
-            x = x + att
-            h2 = ln(x, f"block{i}_ln2")
-            ff = jax.nn.gelu(h2 @ p[f"block{i}_ffn1.w"]
-                             + p[f"block{i}_ffn1.b"])
-            ff = ff @ p[f"block{i}_ffn2.w"] + p[f"block{i}_ffn2.b"]
-            x = x + ff
-        x = ln(x, "ln_f")
-        return x @ p["lm_head.w"], cache
+            x = x + ctx @ w["att_out.w"] + w["att_out.b"]
+            h2 = ln(x, w["ln2.scale"], w["ln2.bias"])
+            ff = jax.nn.gelu(h2 @ w["ffn1.w"] + w["ffn1.b"])
+            x = x + ff @ w["ffn2.w"] + w["ffn2.b"]
+            return x, (ck, cv)
 
-    cache = {}
-    for i in range(n_layer):
-        cache[f"k{i}"] = jnp.zeros((b, max_len, n_head, dh), jnp.float32)
-        cache[f"v{i}"] = jnp.zeros((b, max_len, n_head, dh), jnp.float32)
+        x = p["tok_emb.w"][tok] + pos_emb[t]          # [b, d]
+        x, (cache_k, cache_v) = jax.lax.scan(
+            layer, x, (blk, cache_k, cache_v))
+        x = ln(x, p["ln_f.scale"], p["ln_f.bias"])
+        logits = jnp.matmul(x, p["lm_head.w"],
+                            preferred_element_type=jnp.float32)
+        return logits, cache_k, cache_v
+
+    cache_k = jnp.zeros((n_layer, b, max_len, n_head, dh), compute_dtype)
+    cache_v = jnp.zeros((n_layer, b, max_len, n_head, dh), compute_dtype)
 
     def scan_body(carry, t):
-        tokens, cache, key = carry
+        tokens, cache_k, cache_v, key = carry
         tok = tokens[:, t]
-        logits, cache = step_logits(tok, t, cache)
+        logits, cache_k, cache_v = step_logits(tok, t, cache_k, cache_v)
         if temperature and key is not None:
             key, sub = jax.random.split(key)
             nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
@@ -215,14 +245,17 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
         new = jnp.where(writable, nxt.astype(jnp.int32), cur)
         tokens = jax.lax.dynamic_update_index_in_dim(
             tokens, new, write_to, axis=1)
-        return (tokens, cache, key), logits
+        return (tokens, cache_k, cache_v, key), (
+            logits if return_logits else None)
 
     tokens0 = jnp.zeros((b, max_len), jnp.int32)
     tokens0 = jax.lax.dynamic_update_slice(tokens0, prompt, (0, 0))
     if key is None:
         key = jax.random.PRNGKey(0)
-    (tokens, _, _), logits = jax.lax.scan(
-        scan_body, (tokens0, cache, key), jnp.arange(max_len))
+    (tokens, _, _, _), logits = jax.lax.scan(
+        scan_body, (tokens0, cache_k, cache_v, key), jnp.arange(max_len))
+    if not return_logits:
+        return tokens, None
     return tokens, jnp.swapaxes(logits, 0, 1)  # [b, T] , [b, T, vocab]
 
 

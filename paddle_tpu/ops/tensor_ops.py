@@ -145,6 +145,23 @@ def lookup_table(W, Ids, padding_idx=-1, is_sparse=False, **_):
     return {"Out": out}
 
 
+@register_op("sparse_fc")
+def sparse_fc(Ids, Vals, W, **_):
+    """Weighted gather-sum over a sparse input slot: ``Out[..., :] =
+    sum_n Vals[..., n] * W[Ids[..., n], :]`` — the TPU lowering of the
+    reference's fc-over-sparse-Argument matmul (sparse row vector times
+    dense matrix, math/SparseMatrix.cpp).  Ids are 0-padded, Vals
+    0.0-padded, so padding contributes exactly zero; duplicate ids sum.
+    Cost is O(nnz * size); nothing of height ``dim`` is touched beyond
+    the gathered rows, and the backward is a scatter-add of outer
+    products (the SelectedRows gradient, compressed for the DCN path by
+    parallel/sparse.sparse_rows_from_grad)."""
+    ids = Ids.astype(jnp.int32)
+    rows = jnp.take(W, ids, axis=0)  # [..., n, size]
+    out = jnp.sum(rows * Vals[..., None].astype(W.dtype), axis=-2)
+    return {"Out": out}
+
+
 @register_op("embedding_grad_rows")
 def embedding_grad_rows(Grad, Ids, table_height=0, **_):
     """Helper exposing the SelectedRows idea: scatter-add token grads into a

@@ -7,9 +7,13 @@ CACHE_PASS_IN_MEM, and typed slots).
 TPU-native re-design: the decorated generator becomes an ordinary
 composable reader factory (``reader/__init__.py`` protocol) —
 ``process(file_list)`` returns a no-arg reader yielding converted rows
-that ``DataFeeder`` pads/batches.  Sparse slots are densified (dense
-gathers are the TPU path; the DCN sparse path lives in
-``parallel/sparse.py``).
+that ``DataFeeder`` pads/batches.  Sparse slots stay SPARSE end to end
+(reference PyDataProvider2.cpp:195 assembles sparse Arguments; here each
+slot becomes a :class:`SparseRow` of (ids, vals) that the feeder pads to
+``<name>@IDS``/``<name>@VALS`` arrays and ``sparse_fc`` consumes as a
+weighted gather-sum) — a 10M-dim CTR slot never materializes a dense
+row.  ``SparseRow.todense()`` exists for the dense-var fallback; the DCN
+sparse-update path lives in ``parallel/sparse.py``.
 """
 
 import functools
@@ -18,11 +22,45 @@ import numpy as np
 
 __all__ = [
     "provider", "CacheType", "SequenceType", "DataType", "InputType",
+    "SparseRow",
     "dense_vector", "dense_vector_sequence", "dense_array",
     "sparse_binary_vector", "sparse_binary_vector_sequence",
     "sparse_float_vector", "sparse_float_vector_sequence",
     "integer_value", "integer_value_sequence", "integer_sequence",
 ]
+
+
+class SparseRow:
+    """One sample of one sparse slot: ``ids`` [nnz] int64, ``vals`` [nnz]
+    float32 (all-ones for binary slots), ``dim`` the declared vocabulary.
+    The feeder pads batches of these to ``@IDS``/``@VALS`` arrays; nothing
+    of size ``dim`` is ever allocated on the host."""
+
+    __slots__ = ["ids", "vals", "dim"]
+
+    def __init__(self, ids, vals, dim):
+        self.ids = np.asarray(ids, np.int64).reshape(-1)
+        self.vals = (np.ones(self.ids.shape[0], np.float32) if vals is None
+                     else np.asarray(vals, np.float32).reshape(-1))
+        if self.vals.shape != self.ids.shape:
+            raise ValueError(
+                f"sparse slot ids/vals length mismatch: {self.ids.shape[0]}"
+                f" vs {self.vals.shape[0]}")
+        self.dim = int(dim)
+
+    @property
+    def nnz(self):
+        return self.ids.shape[0]
+
+    def todense(self):
+        out = np.zeros(self.dim, np.float32)
+        # duplicate ids ACCUMULATE — matching sparse_fc's gather-sum, so
+        # the dense and native spellings of the same slot agree exactly
+        np.add.at(out, self.ids, self.vals)
+        return out
+
+    def __repr__(self):
+        return f"SparseRow(nnz={self.nnz}, dim={self.dim})"
 
 
 class SequenceType:
@@ -105,7 +143,7 @@ class _Settings:
 
 
 def _convert_slot(value, itype):
-    """One slot of one row -> numpy, densifying sparse slots."""
+    """One slot of one row -> numpy (sparse slots -> SparseRow)."""
     if itype is None:
         return np.asarray(value)
     if itype.type == DataType.Index:
@@ -114,20 +152,17 @@ def _convert_slot(value, itype):
         return np.asarray(value, np.int64)
     if itype.type == DataType.Dense:
         return np.asarray(value, np.float32)
-    # sparse -> dense multi-hot
-    def densify(v):
-        out = np.zeros(itype.dim, np.float32)
+
+    def sparsify(v):
         if itype.type == DataType.SparseNonValue:
-            idx = np.asarray(v, np.int64)
-            out[idx] = 1.0
-        else:
-            for i, val in v:
-                out[int(i)] = float(val)
-        return out
+            return SparseRow(v, None, itype.dim)
+        pairs = list(v)
+        return SparseRow([i for i, _ in pairs], [val for _, val in pairs],
+                         itype.dim)
 
     if itype.seq_type == SequenceType.NO_SEQUENCE:
-        return densify(value)
-    return np.stack([densify(v) for v in value])
+        return sparsify(value)
+    return [sparsify(v) for v in value]
 
 
 def provider(input_types=None, should_shuffle=None, pool_size=-1,

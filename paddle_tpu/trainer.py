@@ -68,7 +68,7 @@ class Trainer:
 
     def train(self, reader, num_passes=1, event_handler=None,
               checkpoint_dir=None, checkpoint_every_n_passes=1,
-              async_checkpoint=False, prefetch=0):
+              async_checkpoint=False, prefetch=0, steps_per_call=1):
         """``async_checkpoint=True`` writes per-pass checkpoints from a
         background thread (io.AsyncCheckpointer): training only pays the
         device->host snapshot, not serialization + disk IO.  Pending
@@ -76,11 +76,36 @@ class Trainer:
 
         ``prefetch=N`` pads/converts and device-transfers up to N batches
         ahead on a producer thread (reader.prefetch_to_device), so steps
-        never stall on the input pipe."""
+        never stall on the input pipe.
+
+        ``steps_per_call=N`` fuses N consecutive batches into ONE device
+        call (``Executor.run_steps`` lax.scan) — the fix for small
+        dispatch-latency-bound models where per-call host overhead
+        dominates (SmallNet: 12.3 -> 2.3 ms/batch).  Identical math to
+        N separate steps (state threads through the scan); events still
+        fire once per batch with that batch's cost — BeginIteration
+        before the group executes, EndIteration after, so a fused group
+        interleaves as Begin..Begin End..End.  ``"auto"`` times the
+        first post-compile batches and switches to N=8 when the step is
+        dispatch-bound: it times a few single steps and one fused group
+        (both post-compile) and keeps whichever is faster per batch —
+        self-calibrating, so it also fuses when a slow host link (not
+        the device) is the bottleneck.  Batches whose padded shapes
+        differ run unfused (shape buckets compile separately anyway);
+        incompatible with ``prefetch`` (the pipe already overlaps the
+        host gap there)."""
         if not self._initialized:
             self.init_params()
         event_handler = event_handler or (lambda e: None)
         fetch = [self.cost] + list(self.extra_fetch)
+        if steps_per_call != 1 and prefetch:
+            raise ValueError("steps_per_call and prefetch are mutually "
+                             "exclusive (prefetch already hides host time)")
+        if steps_per_call != 1:
+            return self._train_fused(reader, num_passes, event_handler,
+                                     checkpoint_dir,
+                                     checkpoint_every_n_passes,
+                                     async_checkpoint, steps_per_call)
         if prefetch:
             from .reader import prefetch_to_device
 
@@ -110,18 +135,127 @@ class Trainer:
                     metrics = [np.asarray(v) for v in vals[1:]]
                     event_handler(EndIteration(pass_id, batch_id, cost,
                                                metrics))
-                if checkpoint_dir and (
-                        pass_id + 1) % checkpoint_every_n_passes == 0:
-                    path = f"{checkpoint_dir}/pass_{pass_id}"
-                    if ckpt is not None:
-                        ckpt.save(path, self.main_program)
-                    else:
-                        _io.save_persistables(self.exe, path,
-                                              self.main_program)
+                self._pass_checkpoint(pass_id, ckpt, checkpoint_dir,
+                                      checkpoint_every_n_passes)
                 event_handler(EndPass(pass_id))
         finally:
             if ckpt is not None:
                 ckpt.close()
+
+    def _train_fused(self, reader, num_passes, event_handler, checkpoint_dir,
+                     checkpoint_every_n_passes, async_checkpoint,
+                     steps_per_call):
+        """The steps_per_call train loop: group same-shape converted
+        batches, stack them [steps, ...], one run_steps per group, unpack
+        stacked fetches back to per-batch events."""
+        fetch = [self.cost] + list(self.extra_fetch)
+        auto = steps_per_call == "auto"
+        group_n = 1 if auto else int(steps_per_call)
+        if not auto and group_n < 1:
+            raise ValueError(f"steps_per_call must be >= 1: {group_n}")
+        ckpt = _io.AsyncCheckpointer() if (
+            checkpoint_dir and async_checkpoint) else None
+        # auto-probe state, shared across passes: single-step timings,
+        # fused-group per-batch timings (first of each is a compile)
+        single_t, fused_t = [], []
+        try:
+            for pass_id in range(num_passes):
+                event_handler(BeginPass(pass_id))
+                batch_id = 0
+                pending = []  # [(feed_dict, signature)]
+
+                def emit_end(batch_id, row):
+                    cost = float(np.asarray(row[0]).reshape(-1)[0])
+                    metrics = [np.asarray(v) for v in row[1:]]
+                    event_handler(EndIteration(pass_id, batch_id, cost,
+                                               metrics))
+
+                def flush(pending, batch_id):
+                    nonlocal group_n, auto
+                    while pending:
+                        sig = pending[0][1]
+                        run = []
+                        for f, s in pending:
+                            if s != sig:
+                                break
+                            run.append(f)
+                        # Begin fires BEFORE execution for every batch of
+                        # the group (a fused group interleaves as
+                        # Begin..Begin End..End — execution is one call)
+                        for k in range(len(run)):
+                            event_handler(BeginIteration(pass_id,
+                                                         batch_id + k))
+                        t0 = time.perf_counter()
+                        if len(run) == 1:  # odd-shaped straggler: plain step
+                            with _profiler.timer("train_batch"):
+                                vals = self.exe.run(
+                                    self.main_program, feed=run[0],
+                                    fetch_list=fetch)
+                            rows = [vals]
+                        else:
+                            stacked = {
+                                k: np.stack([f[k] for f in run])
+                                for k in run[0]
+                            }
+                            with _profiler.timer("train_batch"):
+                                vals = self.exe.run_steps(
+                                    self.main_program, feed=stacked,
+                                    fetch_list=fetch, steps=len(run))
+                            rows = [[np.asarray(v)[i] for v in vals]
+                                    for i in range(len(run))]
+                            if auto:
+                                fused_t.append(
+                                    (time.perf_counter() - t0) / len(run))
+                                if len(fused_t) >= 2:
+                                    # post-compile fused vs single: keep
+                                    # the faster schedule from here on
+                                    if min(fused_t[1:]) < float(
+                                            np.median(single_t[1:])):
+                                        group_n = 8
+                                    else:
+                                        group_n = 1
+                                    auto = False
+                        del pending[: len(run)]
+                        for row in rows:
+                            emit_end(batch_id, row)
+                            batch_id += 1
+                    return batch_id
+
+                for item in reader():
+                    feed = self.feeder.feed(item)
+                    if auto and len(single_t) < 4:
+                        # probe phase 1: single steps (first is a compile)
+                        event_handler(BeginIteration(pass_id, batch_id))
+                        t0 = time.perf_counter()
+                        vals = self.exe.run(self.main_program, feed=feed,
+                                            fetch_list=fetch)
+                        single_t.append(time.perf_counter() - t0)
+                        emit_end(batch_id, vals)
+                        batch_id += 1
+                        if len(single_t) >= 4:
+                            group_n = 8  # probe phase 2: fused groups
+                        continue
+                    sig = tuple(sorted(
+                        (k, v.shape, str(getattr(v, "dtype", "")))
+                        for k, v in feed.items()))
+                    pending.append((feed, sig))
+                    if len(pending) >= group_n:
+                        batch_id = flush(pending, batch_id)
+                batch_id = flush(pending, batch_id)
+                self._pass_checkpoint(pass_id, ckpt, checkpoint_dir,
+                                      checkpoint_every_n_passes)
+                event_handler(EndPass(pass_id))
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+
+    def _pass_checkpoint(self, pass_id, ckpt, checkpoint_dir, every):
+        if checkpoint_dir and (pass_id + 1) % every == 0:
+            path = f"{checkpoint_dir}/pass_{pass_id}"
+            if ckpt is not None:
+                ckpt.save(path, self.main_program)
+            else:
+                _io.save_persistables(self.exe, path, self.main_program)
 
     def test(self, reader, test_program=None, fetch_list=None):
         """Average fetched values over a test reader (reference

@@ -200,9 +200,11 @@ def test_provider_sparse_and_sequence_slots():
         yield [1, 3], [7, 8, 9], [(0, 0.5), (4, 2.0)]
 
     sb, seq, sf = next(process()())
-    assert sb.tolist() == [0, 1, 0, 1, 0, 0]
+    # sparse slots stay sparse (SparseRow); todense() is the explicit
+    # small-dim escape hatch (test_sparse_slots.py covers the native path)
+    assert sb.todense().tolist() == [0, 1, 0, 1, 0, 0]
     assert seq.tolist() == [7, 8, 9] and seq.dtype == np.int64
-    assert sf.tolist() == [0.5, 0, 0, 0, 2.0]
+    assert sf.todense().tolist() == [0.5, 0, 0, 0, 2.0]
 
 
 def test_async_checkpointer_roundtrip(tmp_path):

@@ -207,3 +207,47 @@ def test_accum_sum_and_composite_metrics_not_inflated():
     ref = run(1)
     got = run(2)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_accum_composite_of_sums_and_mixed_raises():
+    """Round-5 review follow-up: an ADDITIVE composite of two batch
+    reduce_sums must also SUM across microbatches (transitive
+    classification), and a sum+mean MIX — which has no exact reassembly —
+    must raise instead of silently returning 1/accum of the truth."""
+
+    def build(mixed):
+        x = pt.layers.data("x", shape=[4], dtype="float32")
+        lbl = pt.layers.data("y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, 1)
+        sq = pt.layers.square_error_cost(pred, lbl)
+        s1 = pt.layers.reduce_sum(sq)
+        s2 = pt.layers.reduce_sum(pt.layers.square(sq))
+        m = pt.layers.mean(sq)
+        comp = pt.layers.sums([s1, m] if mixed else [s1, s2])
+        pt.optimizer.SGD(learning_rate=0.0).minimize(m)
+        return comp, m
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = rng.normal(size=(8, 1)).astype(np.float32)
+
+    def run(accum, mixed=False):
+        pt.core.unique_name.reset()
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 3
+        with pt.program_guard(main, startup):
+            fetches = build(mixed)
+        if accum > 1:
+            pt.gradient_accumulation(main, accum)
+        scope = pt.core.scope.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        vals = exe.run(main, feed={"x": x, "y": y},
+                       fetch_list=list(fetches), scope=scope)
+        return [float(np.asarray(v).sum()) for v in vals]
+
+    np.testing.assert_allclose(run(2), run(1), rtol=1e-5, atol=1e-6)
+    import pytest
+
+    with pytest.raises(ValueError, match="mixes batch-sum"):
+        run(2, mixed=True)

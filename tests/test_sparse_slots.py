@@ -1,0 +1,241 @@
+"""Native sparse input slots — the no-densify path for the reference's
+``sparse_binary_vector``/``sparse_float_vector`` inputs
+(PyDataProvider2.py:90-156 slot types; PyDataProvider2.cpp:195 assembles
+them as sparse Arguments and fc consumes them as sparse-row × dense-matrix,
+math/SparseMatrix.cpp).  TPU design: provider emits SparseRow(ids, vals),
+the feeder pads @IDS/@VALS shadow arrays, sparse_fc gather-sums — nothing
+of size ``dim`` is ever materialized host- or device-side."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.reader import provider as p
+
+from op_test import check_grad, check_output, run_op
+
+
+# ------------------------------------------------------------------ provider
+def test_provider_emits_sparse_rows():
+    @p.provider(input_types=[p.sparse_binary_vector(1_000_000),
+                             p.sparse_float_vector(1_000_000)])
+    def process(settings, filename):
+        yield [3, 999_999], [(0, 0.5), (123_456, 2.0)]
+
+    sb, sf = next(process()())
+    assert isinstance(sb, p.SparseRow) and isinstance(sf, p.SparseRow)
+    assert sb.ids.tolist() == [3, 999_999] and sb.vals.tolist() == [1.0, 1.0]
+    assert sf.ids.tolist() == [0, 123_456]
+    assert sf.vals.tolist() == [0.5, 2.0]
+    assert sb.dim == sf.dim == 1_000_000
+    # densification is available but explicit — and small-dim exact
+    small = p.SparseRow([1, 3], None, 6)
+    assert small.todense().tolist() == [0, 1, 0, 1, 0, 0]
+
+
+def test_provider_sparse_sequence_slots():
+    @p.provider(input_types=[p.sparse_binary_vector_sequence(50)])
+    def process(settings, filename):
+        yield ([[1, 2], [4]],)
+
+    (seq,) = next(process()())
+    assert isinstance(seq, list) and len(seq) == 2
+    assert seq[0].ids.tolist() == [1, 2] and seq[1].ids.tolist() == [4]
+
+
+# -------------------------------------------------------------------- feeder
+def test_feeder_native_sparse_slot():
+    var = layers.sparse_data("bag", dim=1_000_000,
+                             main_program=pt.Program())
+    feeder = pt.DataFeeder([var], pad_multiple=4)
+    feed = feeder.feed([
+        (p.SparseRow([5, 999_999], [1.0, 3.0], 1_000_000),),
+        (p.SparseRow([7], None, 1_000_000),),
+    ])
+    ids, vals = feed["bag@IDS"], feed["bag@VALS"]
+    assert "bag" not in feed, "handle var must never be materialized"
+    assert ids.shape == (2, 4) and vals.shape == (2, 4)  # padded to multiple
+    assert ids[0].tolist() == [5, 999_999, 0, 0]
+    assert vals[0].tolist() == [1.0, 3.0, 0.0, 0.0]
+    assert vals[1].tolist() == [1.0, 0.0, 0.0, 0.0]
+
+
+def test_feeder_dense_fallback_densifies():
+    prog = pt.Program()
+    var = layers.data("x", shape=[6], main_program=prog)
+    feed = pt.DataFeeder([var]).feed([(p.SparseRow([1, 3], None, 6),)])
+    assert feed["x"].shape == (1, 6)
+    assert feed["x"][0].tolist() == [0, 1, 0, 1, 0, 0]
+
+
+def test_feeder_sparse_sequence_slot():
+    prog = pt.Program()
+    with pt.program_guard(prog, pt.Program()):
+        var = layers.sparse_data("seq", dim=100, lod_level=1)
+    feeder = pt.DataFeeder([var], pad_multiple=2)
+    feed = feeder.feed([
+        ([p.SparseRow([1], None, 100), p.SparseRow([2, 3], None, 100),
+          p.SparseRow([4], None, 100)],),
+        ([p.SparseRow([9], None, 100)],),
+    ])
+    assert feed["seq@IDS"].shape == (2, 4, 2)  # t padded 3->4, nnz 2
+    assert feed["seq@LENGTH"].tolist() == [3, 1]
+    assert feed["seq@IDS"][0, 1].tolist() == [2, 3]
+    assert feed["seq@VALS"][1, 0].tolist() == [1.0, 0.0]
+
+
+# ------------------------------------------------------------------------ op
+def test_sparse_fc_matches_dense():
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(50, 8)).astype(np.float32)
+    ids = np.array([[3, 7, 0, 0], [49, 0, 0, 0]], np.int64)
+    vals = np.array([[1.0, 2.0, 0, 0], [0.5, 0, 0, 0]], np.float32)
+    dense = np.zeros((2, 50), np.float32)
+    dense[0, 3], dense[0, 7], dense[1, 49] = 1.0, 2.0, 0.5
+    check_output("sparse_fc", {"Ids": ids, "Vals": vals, "W": W},
+                 {"Out": dense @ W}, atol=1e-5)
+    # leading batch dims beyond 2-D (sequence slots)
+    out3 = run_op("sparse_fc", {"Ids": ids[:, None, :],
+                                "Vals": vals[:, None, :], "W": W})["Out"]
+    np.testing.assert_allclose(out3[:, 0], dense @ W, atol=1e-5)
+
+
+def test_sparse_fc_grads():
+    rng = np.random.default_rng(1)
+    inputs = {
+        "Ids": np.array([[2, 5, 0], [1, 1, 0]], np.int64),  # dup ids sum
+        "Vals": rng.normal(size=(2, 3)).astype(np.float32),
+        "W": rng.normal(size=(9, 4)).astype(np.float32),
+    }
+    check_grad("sparse_fc", inputs, wrt="W")
+    check_grad("sparse_fc", inputs, wrt="Vals")
+
+
+# ------------------------------------------------------- end-to-end training
+def test_sparse_fc_program_matches_dense_fc():
+    """Same math, two spellings: fc over a native sparse slot vs fc over
+    the densified input — losses and the trained weight must agree (the
+    reference's test_CompareTwoNets discipline)."""
+    rng = np.random.default_rng(2)
+    dim, size, bs = 40, 5, 6
+    rows = [p.SparseRow(rng.choice(dim, rng.integers(1, 5), replace=False),
+                        None, dim)
+            for _ in range(bs)]
+    y = rng.normal(size=(bs, 1)).astype(np.float32)
+
+    def train(sparse):
+        prog, start = pt.Program(), pt.Program()
+        with pt.program_guard(prog, start):
+            if sparse:
+                x = layers.sparse_data("x", dim=dim)
+            else:
+                x = layers.data("x", shape=[dim])
+            label = layers.data("y", shape=[1])
+            pred = layers.fc(
+                x, size,
+                param_attr=pt.ParamAttr(
+                    name="w", initializer=pt.initializer.Constant(0.01)),
+                bias_attr=False)
+            pred = layers.fc(pred, 1, param_attr=pt.ParamAttr(
+                name="w2", initializer=pt.initializer.Constant(0.05)))
+            loss = layers.mean(layers.square_error_cost(pred, label))
+            pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(start)
+        feeder = pt.DataFeeder([x, label])
+        losses = []
+        for _ in range(3):
+            feed = feeder.feed([(r, yy) for r, yy in zip(rows, y)])
+            losses.append(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+        w = np.asarray(pt.core.scope.global_scope().get("w"))
+        return np.asarray(losses), w
+
+    sl, sw = train(sparse=True)
+    dl, dw = train(sparse=False)
+    np.testing.assert_allclose(sl, dl, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sw, dw, rtol=1e-5, atol=1e-6)
+
+
+def test_ctr_sparse_slots_trains_at_vocab_scale():
+    """The verdict's acceptance bar: a reference-style CTR config with raw
+    million-dim sparse slots trains — and the host never builds anything
+    of size dim (the feed arrays stay O(nnz))."""
+    rng = np.random.default_rng(3)
+    prog, start = pt.Program(), pt.Program()
+    with pt.program_guard(prog, start):
+        outs = pt.models.ctr_dnn.build_sparse_slots(
+            sparse_feature_dim=1_000_000, num_slots=2, dense_dim=4,
+            hidden=(16,))
+    exe = pt.Executor()
+    exe.run(start)
+    feeder = pt.DataFeeder(outs["feed"])
+    bs = 8
+    losses = []
+    for _ in range(3):
+        batch = []
+        for _ in range(bs):
+            row = [rng.normal(size=4).astype(np.float32)]
+            for _ in range(2):
+                k = int(rng.integers(1, 40))
+                row.append(p.SparseRow(
+                    rng.choice(1_000_000, k, replace=False), None, 1_000_000))
+            row.append(np.asarray([rng.integers(0, 2)], np.int64))
+            batch.append(tuple(row))
+        feed = feeder.feed(batch)
+        assert all(v.size < 10_000 for v in feed.values()), \
+            "feed must stay O(nnz), not O(dim)"
+        losses.append(float(np.asarray(
+            exe.run(prog, feed=feed,
+                    fetch_list=[outs["avg_cost"]])[0]).reshape(())))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0] * 1.5
+
+
+def test_feeder_dense_fallback_sequence():
+    """Regression (round-5 review): a sparse *sequence* slot feeding a
+    plain dense lod_level=1 var must densify per timestep (the pre-native
+    behavior), not crash."""
+    prog = pt.Program()
+    with pt.program_guard(prog, pt.Program()):
+        var = layers.data("x", shape=[6], lod_level=1)
+    feed = pt.DataFeeder([var], pad_multiple=2).feed([
+        ([p.SparseRow([1], None, 6), p.SparseRow([2, 4], None, 6)],),
+        ([p.SparseRow([0], None, 6)],),
+    ])
+    assert feed["x"].shape == (2, 2, 6)
+    assert feed["x"][0, 1].tolist() == [0, 0, 1, 0, 1, 0]
+    assert feed["x@LENGTH"].tolist() == [2, 1]
+
+
+def test_v1_data_layer_sparse_and_sequence():
+    """data_layer(sparse=True) -> native sparse handle; with seq_len it
+    must declare lod_level=1 so sequence rows feed correctly."""
+    from paddle_tpu.compat import v1
+
+    prog, start = pt.Program(), pt.Program()
+    with pt.program_guard(prog, start):
+        flat = v1.data_layer("bag", size=1000, sparse=True)
+        seq = v1.data_layer("seqbag", size=1000, sparse=True, seq_len=4)
+        out = v1.fc_layer(input=flat, size=3)
+    assert getattr(flat, "sparse_slot", False) and flat.lod_level == 0
+    assert getattr(seq, "sparse_slot", False) and seq.lod_level == 1
+    feed = pt.DataFeeder([flat, seq], pad_multiple=2).feed([
+        (p.SparseRow([7], None, 1000),
+         [p.SparseRow([1, 2], None, 1000), p.SparseRow([3], None, 1000)]),
+    ])
+    assert feed["seqbag@IDS"].shape == (1, 2, 2)
+    assert feed["seqbag@LENGTH"].tolist() == [2]
+    assert out.shape[-1] == 3
+
+
+def test_duplicate_ids_same_both_spellings():
+    """Duplicate indices ACCUMULATE identically through todense() and
+    sparse_fc (round-5 review: the two spellings must agree)."""
+    row = p.SparseRow([5, 5], [1.0, 2.0], 9)
+    assert row.todense()[5] == 3.0
+    rng = np.random.default_rng(5)
+    W = rng.normal(size=(9, 4)).astype(np.float32)
+    out = run_op("sparse_fc", {
+        "Ids": row.ids[None], "Vals": row.vals[None], "W": W})["Out"]
+    np.testing.assert_allclose(out[0], row.todense() @ W, rtol=1e-5,
+                               atol=1e-6)
