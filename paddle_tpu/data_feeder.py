@@ -93,11 +93,12 @@ class DataFeeder:
             nnz = _round_up(nnz, self.pad_multiple)
             ids = np.zeros((len(col), max_t, nnz), np.int64)
             vals = np.zeros((len(col), max_t, nnz), np.float32)
+            # max_t/nnz are padded batch maxima, so no cell can truncate
             for j, c in enumerate(col):
-                for k, r in enumerate(c[:max_t]):
+                for k, r in enumerate(c):
                     ids[j, k, : r.nnz] = r.ids
                     vals[j, k, : r.nnz] = r.vals
-            result[var.name + LENGTH_SUFFIX] = np.minimum(lens, max_t)
+            result[var.name + LENGTH_SUFFIX] = lens
         else:
             nnz = max(1, _round_up(max(c.nnz for c in col),
                                    self.pad_multiple))

@@ -154,8 +154,18 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
     if temperature and key is None:
         raise ValueError("temperature > 0 sampling requires a PRNG `key`")
     if compute_dtype is None:
-        compute_dtype = jnp.result_type(*(jnp.asarray(v).dtype
-                                          for v in params.values()))
+        # the big matmul weights decide the serving dtype; the embedding
+        # tables are deliberately f32 in training (master-precision rows,
+        # cast after gather) and result_type over all params would let
+        # them promote the whole decode (and its KV caches) to f32.
+        # Rule: the narrowest floating dtype among the >=2-D weights —
+        # robust to head/naming variations (a weight-tied or renamed head
+        # must not silently fall back to the f32 embedding's dtype).
+        mats = [jnp.asarray(v).dtype for v in params.values()
+                if jnp.asarray(v).ndim >= 2
+                and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)]
+        compute_dtype = (min(mats, key=lambda d: jnp.dtype(d).itemsize)
+                         if mats else jnp.float32)
     p = {k: jnp.asarray(v, compute_dtype) for k, v in params.items()}
     b, p_len = prompt.shape
     dh = d_model // n_head
@@ -177,32 +187,33 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
         xn = ((x32 - mu) / jnp.sqrt(var + eps)).astype(x.dtype)
         return xn * scale + bias
 
-    # Per-layer weights stacked [L, ...] so the block stack is ONE
-    # lax.scan over layers, not n_layer inlined copies — the compiled
-    # step graph stays layer-count-independent (a 12L/512-step decode
-    # otherwise emits an HLO big enough to time out compile services).
-    _BLK = ("ln1.scale", "ln1.bias", "att_q.w", "att_q.b", "att_k.w",
-            "att_k.b", "att_v.w", "att_v.b", "att_out.w", "att_out.b",
-            "ln2.scale", "ln2.bias", "ffn1.w", "ffn1.b", "ffn2.w",
-            "ffn2.b")
-    blk = {name: jnp.stack([p[f"block{i}_{name}"] for i in range(n_layer)])
-           for name in _BLK}
-
+    # Layers stay UNROLLED in the step body: each layer's [b, T, h, dh]
+    # cache is a separate while-loop carry that XLA updates in place.
+    # (A lax.scan over stacked layers was tried and profiled 2.5x slower:
+    # the stacked [L, b, T, h, dh] carry forced two full-cache copies
+    # per token plus per-layer slice/update churn — 60% of decode time.
+    # HLO size is not a reason to scan: pass params as jit ARGUMENTS,
+    # closing over them bakes the weights into the HLO as constants.)
     def step_logits(tok, t, cache_k, cache_v):
         """One token [b] at position t -> (logits [b, vocab], caches').
-        cache_k/cache_v: [L, b, T, h, dh]."""
-
-        def layer(x, wl):
-            w, ck, cv = wl
-            h = ln(x, w["ln1.scale"], w["ln1.bias"])
-            q = h @ w["att_q.w"] + w["att_q.b"]
-            k = h @ w["att_k.w"] + w["att_k.b"]
-            v = h @ w["att_v.w"] + w["att_v.b"]
+        cache_k/cache_v: tuples of n_layer [b, T, h, dh] arrays."""
+        x = p["tok_emb.w"][tok] + pos_emb[t]          # [b, d]
+        ck_out, cv_out = [], []
+        for i in range(n_layer):
+            w = lambda nm: p[f"block{i}_{nm}"]
+            h = ln(x, w("ln1.scale"), w("ln1.bias"))
+            q = h @ w("att_q.w") + w("att_q.b")
+            k = h @ w("att_k.w") + w("att_k.b")
+            v = h @ w("att_v.w") + w("att_v.b")
             qh = q.reshape(b, n_head, dh)
             kh = k.reshape(b, n_head, dh)
             vh = v.reshape(b, n_head, dh)
-            ck = jax.lax.dynamic_update_index_in_dim(ck, kh, t, axis=1)
-            cv = jax.lax.dynamic_update_index_in_dim(cv, vh, t, axis=1)
+            ck = jax.lax.dynamic_update_index_in_dim(
+                cache_k[i], kh, t, axis=1)
+            cv = jax.lax.dynamic_update_index_in_dim(
+                cache_v[i], vh, t, axis=1)
+            ck_out.append(ck)
+            cv_out.append(cv)
             s = jnp.einsum("bhd,bThd->bhT", qh, ck,
                            preferred_element_type=jnp.float32)
             s = s / jnp.sqrt(float(dh))
@@ -210,22 +221,19 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
             s = jnp.where(mask, s, -1e30)
             a = jax.nn.softmax(s, axis=-1).astype(ck.dtype)
             ctx = jnp.einsum("bhT,bThd->bhd", a, cv).reshape(b, d_model)
-            x = x + ctx @ w["att_out.w"] + w["att_out.b"]
-            h2 = ln(x, w["ln2.scale"], w["ln2.bias"])
-            ff = jax.nn.gelu(h2 @ w["ffn1.w"] + w["ffn1.b"])
-            x = x + ff @ w["ffn2.w"] + w["ffn2.b"]
-            return x, (ck, cv)
-
-        x = p["tok_emb.w"][tok] + pos_emb[t]          # [b, d]
-        x, (cache_k, cache_v) = jax.lax.scan(
-            layer, x, (blk, cache_k, cache_v))
+            x = x + ctx @ w("att_out.w") + w("att_out.b")
+            h2 = ln(x, w("ln2.scale"), w("ln2.bias"))
+            ff = jax.nn.gelu(h2 @ w("ffn1.w") + w("ffn1.b"))
+            x = x + ff @ w("ffn2.w") + w("ffn2.b")
         x = ln(x, p["ln_f.scale"], p["ln_f.bias"])
         logits = jnp.matmul(x, p["lm_head.w"],
                             preferred_element_type=jnp.float32)
-        return logits, cache_k, cache_v
+        return logits, tuple(ck_out), tuple(cv_out)
 
-    cache_k = jnp.zeros((n_layer, b, max_len, n_head, dh), compute_dtype)
-    cache_v = jnp.zeros((n_layer, b, max_len, n_head, dh), compute_dtype)
+    cache_k = tuple(jnp.zeros((b, max_len, n_head, dh), compute_dtype)
+                    for _ in range(n_layer))
+    cache_v = tuple(jnp.zeros((b, max_len, n_head, dh), compute_dtype)
+                    for _ in range(n_layer))
 
     def scan_body(carry, t):
         tokens, cache_k, cache_v, key = carry

@@ -206,11 +206,13 @@ class Trainer:
                             if auto:
                                 fused_t.append(
                                     (time.perf_counter() - t0) / len(run))
-                                if len(fused_t) >= 2:
-                                    # post-compile fused vs single: keep
+                                if len(fused_t) >= 3:
+                                    # compare post-compile MEDIANS (a
+                                    # single sample through a jittery
+                                    # host link decides nothing): keep
                                     # the faster schedule from here on
-                                    if min(fused_t[1:]) < float(
-                                            np.median(single_t[1:])):
+                                    if float(np.median(fused_t[1:])) < \
+                                            float(np.median(single_t[1:])):
                                         group_n = 8
                                     else:
                                         group_n = 1
