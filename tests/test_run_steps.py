@@ -131,3 +131,42 @@ def test_trainer_steps_per_call_matches_unfused():
     np.testing.assert_allclose(fused_losses, ref_losses, rtol=1e-5,
                                atol=1e-6)
     np.testing.assert_allclose(fused_w, ref_w, rtol=1e-5, atol=1e-5)
+
+
+def test_trainer_steps_per_call_auto_is_equivalent():
+    """'auto' probes both schedules then commits to one — whichever it
+    picks (timing-dependent), the trained math must equal the unfused
+    loop and events must stay per-batch."""
+    from paddle_tpu.models import lenet
+
+    rng = np.random.default_rng(3)
+    n_batches = 30  # enough to cover probe (4 single + 3 fused groups)
+    imgs = rng.normal(size=(n_batches, 8, 1, 28, 28)).astype(np.float32)
+    lbls = rng.integers(0, 10, (n_batches, 8, 1)).astype(np.int64)
+
+    def reader():
+        for t in range(n_batches):
+            yield [(imgs[t][i], lbls[t][i]) for i in range(8)]
+
+    def train(steps_per_call):
+        prog, start = pt.Program(), pt.Program()
+        with pt.program_guard(prog, start):
+            outs = lenet.build(learning_rate=0.01)
+        trainer = pt.trainer.Trainer(outs["avg_cost"], outs["feed"],
+                                     main_program=prog,
+                                     startup_program=start)
+        trainer.init_params()
+        ends = []
+        trainer.train(reader, num_passes=1, steps_per_call=steps_per_call,
+                      event_handler=lambda e: ends.append(e) if isinstance(
+                          e, pt.trainer.EndIteration) else None)
+        assert [e.batch_id for e in ends] == list(range(n_batches))
+        w = np.asarray(pt.core.scope.global_scope().get(
+            prog.all_parameters()[0].name))
+        return [e.cost for e in ends], w
+
+    ref_losses, ref_w = train(1)
+    auto_losses, auto_w = train("auto")
+    np.testing.assert_allclose(auto_losses, ref_losses, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(auto_w, ref_w, rtol=1e-5, atol=1e-5)
