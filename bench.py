@@ -19,19 +19,11 @@ import time
 
 import numpy as np
 
-# bf16 peak TFLOP/s by device_kind substring (public chip specs)
-PEAK_BF16 = (
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
-    ("v6", 918e12), ("v4", 275e12), ("v3", 123e12),
-)
-
-
 def chip_peak_flops(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, peak in PEAK_BF16:
-        if sub in kind:
-            return peak
-    return float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+    # single source of truth for chip peaks (bench + trainer MFU field)
+    from paddle_tpu.observability.hardware import device_peak_flops
+
+    return device_peak_flops(device)
 
 
 def timed_steps(exe, prog, feed, fetch, steps, warmup, repeats=None):
@@ -345,6 +337,58 @@ def memory_gate():
     return out
 
 
+def _err_str(e):
+    """One-line, bounded error for the JSON output: an HBM OOM dump is
+    tens of KB of allocation tables — keep the head, drop the rest."""
+    s = f"{type(e).__name__}: {e}"
+    return " ".join(s.split())[:300]
+
+
+def bench_smoke():
+    """CPU-safe tiny training config (LeNet bs8) — the fallback row when
+    there is no accelerator or every flagship failed, so the harness
+    ALWAYS gets a parseable JSON line instead of an OOM dump + rc=1."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import lenet
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        outs = lenet.build(learning_rate=0.01)
+    exe = pt.Executor()
+    exe.run(startup)
+    batch, steps = 8, 5
+    img = np.random.rand(batch, 1, 28, 28).astype(np.float32)
+    label = np.random.randint(0, 10, (batch, 1)).astype(np.int64)
+    dt, _times, cost = timed_steps(
+        exe, main_prog, {"img": img, "label": label},
+        [outs["avg_cost"]], steps, warmup=2, repeats=3)
+    assert np.isfinite(cost[0]).all()
+    return batch * steps / dt
+
+
+def _print_smoke(errors):
+    try:
+        v = bench_smoke()
+        extra = {"smoke": True}
+        if errors:
+            extra["errors"] = errors
+        print(json.dumps({
+            "metric": "smoke_train_images_per_sec",
+            "value": round(v, 1),
+            "unit": "img/s",
+            "vs_baseline": None,
+            "extra": extra,
+        }))
+        return 1 if errors else 0
+    except Exception as e:  # noqa: BLE001 — last resort, still emit JSON
+        errors = dict(errors, smoke=_err_str(e))
+        print(json.dumps({
+            "metric": "bench_failed", "value": None, "unit": None,
+            "vs_baseline": None, "extra": {"errors": errors},
+        }))
+        return 1
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
@@ -355,9 +399,22 @@ def main():
             f"BENCH_MODELS contains unknown model(s) {sorted(unknown)}; "
             f"valid: resnet, gpt")
 
-    import jax
+    errors = {}
+    try:
+        import jax
 
-    n_chips = max(len(jax.devices()), 1)
+        devices = jax.devices()
+    except Exception as e:  # backend/tunnel init failure
+        errors["devices"] = _err_str(e)
+        devices = []
+    has_accel = any(d.platform != "cpu" for d in devices)
+    if errors or not has_accel or os.environ.get(
+            "BENCH_SMOKE", "").lower() in ("1", "true", "yes"):
+        # no accelerator (or forced): the flagship configs OOM/crawl on
+        # CPU — produce the smoke row instead of a stack trace
+        return _print_smoke(errors)
+
+    n_chips = max(len(devices), 1)
 
     def mesh_factory(main_prog, startup):
         if n_chips <= 1:
@@ -371,27 +428,51 @@ def main():
 
     extra = {}
     img_per_chip = None
+    tok_per_chip = None
     if "resnet" in which:
-        img_per_chip, img_min, img_max = bench_resnet(
-            n_chips, mesh_factory, steps, warmup)
-        extra["resnet_img_s_min"] = round(img_min, 1)
-        extra["resnet_img_s_max"] = round(img_max, 1)
+        try:
+            img_per_chip, img_min, img_max = bench_resnet(
+                n_chips, mesh_factory, steps, warmup)
+            extra["resnet_img_s_min"] = round(img_min, 1)
+            extra["resnet_img_s_max"] = round(img_max, 1)
+        except Exception as e:
+            errors["resnet"] = _err_str(e)
     if "gpt" in which:
-        tok_per_chip, mfu, tok_min, tok_max = bench_gpt(
-            n_chips, mesh_factory, steps, warmup)
-        extra["gpt_tokens_per_sec_per_chip"] = round(tok_per_chip, 1)
-        extra["gpt_mfu"] = round(mfu, 4)
-        extra["gpt_tok_s_min"] = round(tok_min, 1)
-        extra["gpt_tok_s_max"] = round(tok_max, 1)
+        try:
+            tok_per_chip, mfu, tok_min, tok_max = bench_gpt(
+                n_chips, mesh_factory, steps, warmup)
+            extra["gpt_tokens_per_sec_per_chip"] = round(tok_per_chip, 1)
+            extra["gpt_mfu"] = round(mfu, 4)
+            extra["gpt_tok_s_min"] = round(tok_min, 1)
+            extra["gpt_tok_s_max"] = round(tok_max, 1)
+        except Exception as e:
+            errors["gpt"] = _err_str(e)
     if os.environ.get("BENCH_FLASH_GATE", "1").lower() not in (
             "0", "", "false"):
-        extra["flash_max_rel_err"] = round(flash_numeric_gate(), 7)
-        extra.update(grad_numeric_gates())
+        try:
+            extra["flash_max_rel_err"] = round(flash_numeric_gate(), 7)
+        except Exception as e:
+            errors["flash_gate"] = _err_str(e)
+        try:
+            extra.update(grad_numeric_gates())
+        except Exception as e:
+            errors["grad_gates"] = _err_str(e)
     if os.environ.get("BENCH_MEM_GATE", "1").lower() not in (
             "0", "", "false"):
-        extra.update(memory_gate())
+        try:
+            extra.update(memory_gate())
+        except Exception as e:
+            errors["mem_gate"] = _err_str(e)
+    if errors:
+        extra["errors"] = errors
 
-    if img_per_chip is None:  # gpt-only run (BENCH_MODELS=gpt)
+    if img_per_chip is None and tok_per_chip is None:
+        # every requested flagship failed (e.g. HBM OOM): fall back to
+        # the smoke row so stdout stays one parseable JSON line
+        return _print_smoke(errors)
+    if img_per_chip is None:
+        # gpt-only run (BENCH_MODELS=gpt), or resnet failed while gpt
+        # succeeded (errors non-empty -> rc 1 either way)
         print(json.dumps({
             "metric": "gpt_train_tokens_per_sec_per_chip",
             "value": extra["gpt_tokens_per_sec_per_chip"],
@@ -400,7 +481,7 @@ def main():
             "extra": {k: v for k, v in extra.items()
                       if not k.startswith("gpt_tokens")},
         }))
-        return
+        return 1 if errors else 0
     target_per_chip = 3000.0 / 16.0
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -409,6 +490,7 @@ def main():
         "vs_baseline": round(img_per_chip / target_per_chip, 3),
         "extra": extra,
     }))
+    return 1 if errors else 0
 
 
 if __name__ == "__main__":

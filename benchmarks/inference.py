@@ -29,6 +29,26 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _lat_dict(hist):
+    """Histogram of per-call latencies (ms) -> the JSON lat_* fields."""
+    pct = hist.percentiles((50, 95, 99))
+    return {f"lat_p{p}_ms": round(v, 3) for p, v in pct.items()}
+
+
+def measure_latency(run_once, calls=30):
+    """Per-call latency distribution (p50/p95/p99 ms) with a host sync
+    per call — the serving-side tail number async-dispatch throughput
+    hides.  ``run_once`` must materialize its result on the host."""
+    from paddle_tpu.observability.metrics import Histogram
+
+    hist = Histogram("latency_ms")
+    for _ in range(calls):
+        t0 = time.perf_counter()
+        run_once()
+        hist.observe((time.perf_counter() - t0) * 1e3)
+    return _lat_dict(hist)
+
+
 def bench_resnet_infer(batch=16, steps=20, warmup=3, repeats=5):
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -47,7 +67,10 @@ def bench_resnet_infer(batch=16, steps=20, warmup=3, repeats=5):
     _, times, _ = timed_steps(exe, main_prog, feed, [outs["prediction"]],
                               steps, warmup, repeats=repeats)
     rates = [batch * steps / t for t in times]
-    return float(np.median(rates)), min(rates), max(rates)
+    lat = measure_latency(lambda: np.asarray(exe.run(
+        main_prog, feed=feed, fetch_list=[outs["prediction"]],
+        return_numpy=False)[0]))
+    return float(np.median(rates)), min(rates), max(rates), lat
 
 
 def bench_gpt_decode(batch=16, prompt_len=16, max_len=512, repeats=5):
@@ -87,13 +110,20 @@ def bench_gpt_decode(batch=16, prompt_len=16, max_len=512, repeats=5):
     toks = gen(params, prompt)  # compile
     np.asarray(toks)
     new_tokens = batch * (max_len - prompt_len)
-    rates = []
+    rates, lat_ms = [], []
     for _ in range(repeats):
         t0 = time.perf_counter()
         toks = gen(params, prompt)
         np.asarray(toks)
-        rates.append(new_tokens / (time.perf_counter() - t0))
-    return float(np.median(rates)), min(rates), max(rates)
+        dt = time.perf_counter() - t0
+        rates.append(new_tokens / dt)
+        lat_ms.append(dt * 1e3)
+    from paddle_tpu.observability.metrics import Histogram
+
+    hist = Histogram("decode_ms")
+    for v in lat_ms:
+        hist.observe(v)
+    return float(np.median(rates)), min(rates), max(rates), _lat_dict(hist)
 
 
 def bench_capi(repeats=200):
@@ -160,13 +190,15 @@ def bench_capi(repeats=200):
         assert out_rank.value == 2
 
     roundtrip()  # compile
-    lat = []
+    from paddle_tpu.observability.metrics import Histogram
+
+    hist = Histogram("capi_ms")
     for _ in range(repeats):
         t0 = time.perf_counter()
         roundtrip()
-        lat.append((time.perf_counter() - t0) * 1e3)
-    return (float(np.median(lat)), float(np.percentile(lat, 99)),
-            float(min(lat)))
+        hist.observe((time.perf_counter() - t0) * 1e3)
+    pct = hist.percentiles((50, 99))
+    return pct[50], pct[99], hist.min, _lat_dict(hist)
 
 
 def main():
@@ -183,21 +215,21 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     if "resnet" in rows:
-        med, lo, hi = bench_resnet_infer()
+        med, lo, hi, lat = bench_resnet_infer()
         print(json.dumps({
             "metric": "resnet50_infer_bs16_img_s", "value": round(med, 1),
             "min": round(lo, 1), "max": round(hi, 1),
-            "vs_reference_217.69": round(med / 217.69, 2)}))
+            "vs_reference_217.69": round(med / 217.69, 2), **lat}))
     if "gpt" in rows:
-        med, lo, hi = bench_gpt_decode()
+        med, lo, hi, lat = bench_gpt_decode()
         print(json.dumps({
             "metric": "gpt_decode_tok_s_bs16", "value": round(med, 1),
-            "min": round(lo, 1), "max": round(hi, 1)}))
+            "min": round(lo, 1), "max": round(hi, 1), **lat}))
     if "capi" in rows:
-        med, p99, lo = bench_capi()
+        med, p99, lo, lat = bench_capi()
         print(json.dumps({
             "metric": "capi_roundtrip_ms", "value": round(med, 3),
-            "p99": round(p99, 3), "min": round(lo, 3),
+            "p99": round(p99, 3), "min": round(lo, 3), **lat,
             "note": "includes host<->device tunnel latency in this env"}))
 
 

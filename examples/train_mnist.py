@@ -20,6 +20,9 @@ def main():
     ap.add_argument("--passes", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--out", default="mnist_model")
+    ap.add_argument("--run-log", default=None,
+                    help="write per-step telemetry (wall time, throughput, "
+                         "MFU, compile counts) to this JSONL file")
     args = ap.parse_args()
 
     model = pt.models.lenet.build(learning_rate=0.001)
@@ -37,9 +40,17 @@ def main():
 
     tr = pt.trainer.Trainer(model["avg_cost"], model["feed"],
                             extra_fetch=[model["accuracy"]])
-    tr.train(pt.reader.batch(train_reader, args.batch_size),
-             num_passes=args.passes, event_handler=handler,
-             checkpoint_dir="mnist_ckpts", async_checkpoint=True)
+    # telemetry rides along with the user handler: step summaries every
+    # 50 batches + (optionally) a JSONL run log for offline analysis
+    reporter = pt.observability.MetricsReporter(
+        log_every_n=50, jsonl_path=args.run_log)
+    try:
+        tr.train(pt.reader.batch(train_reader, args.batch_size),
+                 num_passes=args.passes,
+                 event_handler=reporter.chain(handler),
+                 checkpoint_dir="mnist_ckpts", async_checkpoint=True)
+    finally:
+        reporter.close()
 
     pt.io.save_inference_model(args.out, ["img"], [model["prediction"]],
                                tr.exe)

@@ -57,6 +57,7 @@ from . import dataset
 from . import data_feeder
 from .data_feeder import DataFeeder
 from . import parallel
+from . import observability
 from . import profiler
 from . import trainer
 from . import models

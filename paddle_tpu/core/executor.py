@@ -25,10 +25,13 @@ fetch list, available state) — the analog of the reference caching nothing
 and paying interpreter overhead per op per step.
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _obs
 from .program import Program, Parameter, default_main_program, GRAD_SUFFIX
 from .registry import get_op_impl
 from .scope import Scope, global_scope, RNG_VAR
@@ -201,6 +204,50 @@ class Executor:
             for d in mesh.devices.flat
         )
         self._cache = {}
+        # Telemetry of the most recent run()/run_steps(): compile_seconds,
+        # static flops / bytes_accessed from XLA cost analysis, cache_hit.
+        # The Trainer reads this to report achieved MFU per step.
+        self.last_step_cost = None
+
+    def _aot_compile(self, jitted, args, label):
+        """Explicit ``lower().compile()`` instead of first-call jit, so
+        compile time and the executable's static cost model are
+        observable: increments ``executor.compile_count``, observes
+        ``executor.compile_seconds``, and extracts flops/bytes from
+        ``compiled.cost_analysis()`` (the reference has no analog — its
+        interpreter never compiles; here the cost model is what turns
+        step wall-time into achieved MFU).  Returns ``(fn, cost)``."""
+        reg = _obs.get_registry()
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*args).compile()
+        dt = time.perf_counter() - t0
+        reg.counter(
+            "executor.compile_count",
+            help="programs compiled (jit cache misses)").inc()
+        reg.histogram("executor.compile_seconds").observe(dt)
+        cost = {"label": label, "compile_seconds": dt,
+                "flops": None, "bytes_accessed": None}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if ca:
+                f = ca.get("flops")
+                b = ca.get("bytes accessed")
+                cost["flops"] = float(f) if f else None
+                cost["bytes_accessed"] = float(b) if b else None
+        except Exception:
+            pass  # some backends/plugins don't implement cost analysis
+        try:
+            mem = compiled.memory_analysis()
+            peak = getattr(mem, "peak_memory_in_bytes", 0) or (
+                mem.output_size_in_bytes + mem.temp_size_in_bytes)
+            if peak:
+                cost["compiled_peak_bytes"] = int(peak)
+                reg.gauge("executor.compiled_peak_bytes").set_max(peak)
+        except Exception:
+            pass
+        return compiled, cost
 
     # ------------------------------------------------------------------
     def _prepare(self, program, feed, fetch_list, scope):
@@ -310,11 +357,22 @@ class Executor:
             tuple(fetch_names),
             state_names,
         )
-        step = self._cache.get(key)
-        if step is None:
+        reg = _obs.get_registry()
+        entry = self._cache.get(key)
+        cache_hit = entry is not None
+        if not cache_hit:
+            reg.counter("executor.cache_misses").inc()
             _check_fetches(program, fetch_names)
-            step = self._compile(program, feed_names, fetch_names, state_names)
-            self._cache[key] = step
+            jitted = self._compile(
+                program, feed_names, fetch_names, state_names)
+            entry = self._aot_compile(
+                jitted, (state,) + tuple(feed_vals),
+                f"run:{program._serial}v{program._version}")
+            self._cache[key] = entry
+        else:
+            reg.counter("executor.cache_hits").inc()
+        step, cost = entry
+        self.last_step_cost = dict(cost, cache_hit=cache_hit)
 
         new_state, fetches = step(state, *feed_vals)
         return self._finish(scope, new_state, fetch_names, fetches,
@@ -362,13 +420,23 @@ class Executor:
             tuple(fetch_names),
             state_names,
         )
-        fn = self._cache.get(key)
-        if fn is None:
+        reg = _obs.get_registry()
+        entry = self._cache.get(key)
+        cache_hit = entry is not None
+        if not cache_hit:
+            reg.counter("executor.cache_misses").inc()
             _check_fetches(program, fetch_names)
-            fn = self._compile_scan(
+            jitted = self._compile_scan(
                 program, feed_names, fetch_names, state_names, steps
             )
-            self._cache[key] = fn
+            entry = self._aot_compile(
+                jitted, (state,) + tuple(feed_vals),
+                f"scan{steps}:{program._serial}v{program._version}")
+            self._cache[key] = entry
+        else:
+            reg.counter("executor.cache_hits").inc()
+        fn, cost = entry
+        self.last_step_cost = dict(cost, cache_hit=cache_hit, steps=steps)
 
         new_state, fetches = fn(state, *feed_vals)
         return self._finish(scope, new_state, fetch_names, fetches,

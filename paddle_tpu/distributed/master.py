@@ -18,6 +18,7 @@ import uuid
 
 from . import rpc
 from .store import InMemStore
+from ..observability import metrics as _obs
 
 SNAPSHOT_KEY = "master/taskqueues"
 
@@ -41,7 +42,7 @@ class Task:
 
 class MasterService:
     def __init__(self, store=None, chunks_per_task=1, timeout_sec=20,
-                 failure_max=3):
+                 failure_max=3, registry=None):
         self.store = store or InMemStore()
         self.chunks_per_task = chunks_per_task
         self.timeout_sec = timeout_sec
@@ -50,9 +51,41 @@ class MasterService:
         self.todo, self.pending, self.done, self.failed = [], {}, [], []
         self._pass_id = 0
         self._dataset_set = False
+        self._reg = registry or _obs.get_registry()
+        self._last_contact = time.time()  # any trainer RPC (heartbeat age)
         self._recover()
+        self._update_queue_gauges()
         self._watcher = threading.Thread(target=self._check_timeouts, daemon=True)
         self._watcher.start()
+
+    # -- telemetry ---------------------------------------------------------
+    def _update_queue_gauges(self):
+        """Queue-depth gauges; called under the lock after transitions."""
+        self._reg.gauge("master.todo_depth").set(len(self.todo))
+        self._reg.gauge("master.pending_depth").set(len(self.pending))
+        self._reg.gauge("master.done_depth").set(len(self.done))
+        self._reg.gauge("master.failed_depth").set(len(self.failed))
+
+    def metrics(self):
+        """RPC surface for scraping: queue depths, lifetime counters and
+        the age of the last trainer contact (a dead fleet shows up as a
+        growing heartbeat age long before timeouts drain pending)."""
+        with self._lock:
+            return {
+                "todo_depth": len(self.todo),
+                "pending_depth": len(self.pending),
+                "done_depth": len(self.done),
+                "failed_depth": len(self.failed),
+                "pass_id": self._pass_id,
+                "tasks_dispatched": self._reg.value(
+                    "master.tasks_dispatched"),
+                "tasks_finished": self._reg.value("master.tasks_finished"),
+                "tasks_failed": self._reg.value("master.tasks_failed"),
+                "timeout_requeues": self._reg.value(
+                    "master.timeout_requeues"),
+                "poisoned_tasks": self._reg.value("master.poisoned_tasks"),
+                "last_contact_age_sec": time.time() - self._last_contact,
+            }
 
     # -- persistence (service.go snapshot:207 / recover:166) ---------------
     def _snapshot(self):
@@ -103,10 +136,12 @@ class MasterService:
                     Task(str(uuid.uuid4()), chunks[i : i + self.chunks_per_task])
                 )
             self._dataset_set = True
+            self._update_queue_gauges()
             self._snapshot()
             return self._pass_id
 
     def get_task(self):
+        self._last_contact = time.time()
         with self._lock:
             if not self.todo:
                 if not self.pending and (self.done or self.failed):
@@ -120,25 +155,33 @@ class MasterService:
             task = self.todo.pop(0)
             task.deadline = time.time() + self.timeout_sec
             self.pending[task.id] = task
+            self._reg.counter("master.tasks_dispatched").inc()
+            self._update_queue_gauges()
             self._snapshot()
             return {"id": task.id, "paths": task.paths, "pass_id": self._pass_id}
 
     def task_finished(self, task_id):
+        self._last_contact = time.time()
         with self._lock:
             task = self.pending.pop(task_id, None)
             if task is None:
                 return False
             task.failures = 0
             self.done.append(task)
+            self._reg.counter("master.tasks_finished").inc()
+            self._update_queue_gauges()
             self._snapshot()
             return True
 
     def task_failed(self, task_id):
+        self._last_contact = time.time()
         with self._lock:
             task = self.pending.pop(task_id, None)
             if task is None:
                 return False
             self._process_failed(task)
+            self._reg.counter("master.tasks_failed").inc()
+            self._update_queue_gauges()
             self._snapshot()
             return True
 
@@ -147,6 +190,7 @@ class MasterService:
         task.failures += 1
         if task.failures >= self.failure_max:
             self.failed.append(task)
+            self._reg.counter("master.poisoned_tasks").inc()
         else:
             self.todo.append(task)
 
@@ -163,6 +207,9 @@ class MasterService:
                     del self.pending[t.id]
                     self._process_failed(t)
                 if expired:
+                    self._reg.counter("master.timeout_requeues").inc(
+                        len(expired))
+                    self._update_queue_gauges()
                     self._snapshot()
 
     # -- exactly-one-saver election (service.go:481 RequestSaveModel) ------

@@ -34,9 +34,12 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+import time
+
 from . import rpc
 from .store import InMemStore, register_service
 from ..core.registry import get_op_impl
+from ..observability import metrics as _obs
 
 
 def assign_server(name, num_servers):
@@ -336,7 +339,8 @@ class ParameterServer:
     """One shard server (hosts the params assigned to its index)."""
 
     def __init__(self, index=0, num_trainers=1, sync=True, store=None,
-                 checkpoint_dir=None, checkpoint_every_n_updates=0):
+                 checkpoint_dir=None, checkpoint_every_n_updates=0,
+                 registry=None):
         self.index = index
         self.num_trainers = num_trainers
         self.sync = sync
@@ -357,8 +361,44 @@ class ParameterServer:
         self._init_done = False
         self._lock = threading.Lock()
         self._barrier = threading.Condition(self._lock)
+        self._reg = registry or _obs.get_registry()
+        self._shard = str(index)
+        self._last_update_time = time.time()
         if checkpoint_dir:
             self._maybe_recover()
+
+    # -- telemetry ---------------------------------------------------------
+    def _count(self, name, n=1):
+        self._reg.counter(name, shard=self._shard).inc(n)
+
+    def _update_param_gauges(self):
+        self._reg.gauge("pserver.param_count", shard=self._shard).set(
+            len(self.params))
+        self._reg.gauge("pserver.param_bytes", shard=self._shard).set(
+            sum(np.asarray(v).nbytes for v in self.params.values()))
+
+    def metrics(self):
+        """RPC surface for scraping this shard: parameter footprint,
+        lifetime update/gradient counters, sync-barrier backlog, and the
+        age of the last applied update (a stalled trainer fleet shows as
+        a growing update age while pending grads sit at the barrier)."""
+        with self._lock:
+            reg = self._reg
+            return {
+                "shard": self.index,
+                "param_count": len(self.params),
+                "param_bytes": int(sum(
+                    np.asarray(v).nbytes for v in self.params.values())),
+                "updates_applied": self._updates,
+                "grads_received": reg.value(
+                    "pserver.grads_received", shard=self._shard),
+                "sparse_grads_received": reg.value(
+                    "pserver.sparse_grads_received", shard=self._shard),
+                "pending_grad_params": len(self._grad_acc),
+                "checkpoints_written": reg.value(
+                    "pserver.checkpoints_written", shard=self._shard),
+                "last_update_age_sec": time.time() - self._last_update_time,
+            }
 
     # -- init (service.go InitParam:229 / FinishInitParams:260) ------------
     def init_param(self, name, value, optimizer="sgd", lr=0.01, attrs=None):
@@ -398,6 +438,7 @@ class ParameterServer:
     def finish_init_params(self):
         with self._lock:
             self._init_done = True
+            self._update_param_gauges()
         return True
 
     def ready(self):
@@ -406,6 +447,7 @@ class ParameterServer:
     # -- training (SendGrad:285 / GetParam:311) ----------------------------
     def send_grad(self, name, grad):
         grad = np.asarray(grad)
+        self._count("pserver.grads_received")
         with self._barrier:
             if not self.sync:
                 self.params[name] = self.opt[name].step(self.params[name], grad)
@@ -432,6 +474,7 @@ class ParameterServer:
     def send_sparse_grad(self, name, rows, values):
         """SelectedRows update (sparse pserver path) through the
         CONFIGURED optimizer with per-row state (lazy semantics)."""
+        self._count("pserver.sparse_grads_received")
         with self._lock:
             orig_dtype = self.params[name].dtype
             updated = self.opt[name].step_rows(
@@ -478,6 +521,10 @@ class ParameterServer:
     # -- checkpoint (service.go:342; CRC + meta in store) ------------------
     def _after_update(self):
         self._updates += 1
+        self._last_update_time = time.time()
+        self._count("pserver.updates_applied")
+        self._reg.gauge("pserver.pending_grad_params",
+                        shard=self._shard).set(len(self._grad_acc))
         if (
             self.checkpoint_dir
             and self.checkpoint_every
@@ -503,6 +550,7 @@ class ParameterServer:
             f"pserver/{self.index}/checkpoint",
             {"path": path, "crc32": zlib.crc32(payload), "updates": self._updates},
         )
+        self._count("pserver.checkpoints_written")
         return path
 
     def _maybe_recover(self):

@@ -1,10 +1,13 @@
 """Inference engine (reference: paddle/inference/inference.{h,cc} — load
 __model__ + persistables, then Executor::Run; v2 inference.py infer())."""
 
+import time
+
 import numpy as np
 
 from .core.executor import Executor
 from .core.scope import Scope, scope_guard
+from .observability import metrics as _obs
 from . import io as _io
 from .data_feeder import DataFeeder
 
@@ -26,13 +29,24 @@ class InferenceEngine:
         self.feeder = DataFeeder(self.feed_vars, place)
 
     def run(self, feed=None, data=None):
-        """feed: {name: ndarray} or data: list of sample tuples."""
-        if data is not None:
-            feed = self.feeder.feed(data)
-        with scope_guard(self.scope):
-            return self.exe.run(
-                self.program, feed=feed, fetch_list=self.fetch_vars
-            )
+        """feed: {name: ndarray} or data: list of sample tuples.
+
+        Each call observes ``inference.run_seconds`` (a latency histogram
+        — p50/p95/p99 via its snapshot) and counts
+        ``inference.requests`` in the global metrics registry."""
+        reg = _obs.get_registry()
+        reg.counter("inference.requests").inc()
+        t0 = time.perf_counter()
+        try:
+            if data is not None:
+                feed = self.feeder.feed(data)
+            with scope_guard(self.scope):
+                return self.exe.run(
+                    self.program, feed=feed, fetch_list=self.fetch_vars
+                )
+        finally:
+            reg.histogram("inference.run_seconds").observe(
+                time.perf_counter() - t0)
 
 
 def infer(dirname, data=None, feed=None, place=None):

@@ -1,0 +1,48 @@
+"""Run-telemetry subsystem — the instrument panel for the whole stack.
+
+The reference ships a real observability layer (platform/profiler
+RecordEvent + aggregated tables, utils/Stat.h REGISTER_TIMER,
+FLAGS_check_nan_inf); on TPU the op loop is compiled away, so the
+equivalents are structural: a metrics registry every subsystem reports
+into, compile/step tracing at the Executor, MFU/throughput accounting at
+the Trainer, and device-memory high-water sampling.
+
+Modules:
+
+* ``metrics``  — Counter/Gauge/Histogram + the global `MetricsRegistry`
+  (Prometheus text exposition, optional HTTP endpoint);
+* ``runlog``   — `RunLog` JSONL structured event log + ``read_jsonl``;
+* ``hardware`` — chip peak-FLOPs table, `mfu`, `device_memory_stats`,
+  `sample_memory` HBM high-water gauges;
+* ``reporter`` — `MetricsReporter`, the Trainer event handler emitting
+  one-line summaries + JSONL step records.
+
+Quick start::
+
+    import paddle_tpu as pt
+    from paddle_tpu.observability import MetricsReporter, get_registry
+
+    reporter = MetricsReporter(log_every_n=10, jsonl_path="run.jsonl")
+    trainer.train(reader, event_handler=reporter)
+    print(get_registry().to_text())   # or start_metrics_server(9464)
+"""
+
+from . import hardware, metrics, reporter, runlog
+from .hardware import (
+    device_memory_stats, device_peak_flops, mfu, sample_memory,
+    total_peak_flops,
+)
+from .metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, get_registry,
+    start_metrics_server,
+)
+from .reporter import MetricsReporter
+from .runlog import RunLog, read_jsonl
+
+__all__ = [
+    "metrics", "runlog", "hardware", "reporter",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "start_metrics_server", "RunLog", "read_jsonl", "MetricsReporter",
+    "device_peak_flops", "total_peak_flops", "mfu",
+    "device_memory_stats", "sample_memory",
+]

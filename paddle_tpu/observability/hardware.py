@@ -1,0 +1,118 @@
+"""Hardware accounting: chip peak FLOP/s, MFU, and device-memory stats.
+
+The peak table is the single source of truth the bench harness
+(`bench.py chip_peak_flops`) and the trainer's MFU field both read —
+public bf16 chip specs keyed by ``device_kind`` substring.
+
+``device_memory_stats`` wraps ``jax.Device.memory_stats()`` (None on CPU)
+and ``sample_memory`` publishes per-device ``device.bytes_in_use`` /
+``device.peak_bytes_in_use`` gauges plus a process-wide
+``device.hbm_high_water_bytes`` high-water mark into the metrics
+registry — the capacity instrument every OOM postmortem starts from.
+"""
+
+import os
+
+from . import metrics as _metrics
+
+__all__ = [
+    "PEAK_BF16", "device_peak_flops", "total_peak_flops", "mfu",
+    "device_memory_stats", "sample_memory",
+]
+
+# bf16 peak FLOP/s by device_kind substring (public chip specs); order
+# matters — first match wins ("v5 lite" before "v5e"-less kinds etc.)
+PEAK_BF16 = (
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v6", 918e12), ("v4", 275e12), ("v3", 123e12),
+)
+
+# Nominal CPU peak so MFU stays defined on CPU runs (dev loops, CI).
+# Absolute CPU MFU is not meaningful against this — only step-to-step
+# deltas are; override with PT_CPU_PEAK_FLOPS.
+_CPU_NOMINAL_PEAK = 1e12
+
+
+def device_peak_flops(device=None):
+    """Peak bf16 FLOP/s for one device.  Resolution order: the chip-spec
+    table by device_kind, then the BENCH_PEAK_FLOPS env override for
+    unknown accelerators, then a nominal CPU constant
+    (PT_CPU_PEAK_FLOPS) so MFU is always computable."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    if getattr(device, "platform", "") == "cpu":
+        return float(os.environ.get("PT_CPU_PEAK_FLOPS",
+                                    _CPU_NOMINAL_PEAK))
+    return float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+
+
+def total_peak_flops(mesh=None, device=None):
+    """Aggregate peak over the devices a step runs on: the mesh's devices
+    when sharded, else one device."""
+    if mesh is not None:
+        return sum(device_peak_flops(d) for d in mesh.devices.flat)
+    return device_peak_flops(device)
+
+
+def mfu(flops_per_step, step_seconds, peak_flops):
+    """Model FLOPs utilization in [0, 1]; None when not computable."""
+    if not flops_per_step or not step_seconds or not peak_flops:
+        return None
+    if step_seconds <= 0 or peak_flops <= 0:
+        return None
+    return flops_per_step / step_seconds / peak_flops
+
+
+def device_memory_stats(device=None):
+    """``device.memory_stats()`` as a plain dict; {} when the backend
+    does not report (CPU, some plugin backends)."""
+    if device is None:
+        import jax
+
+        device = jax.local_devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return {}
+    return dict(stats) if stats else {}
+
+
+def sample_memory(registry=None, devices=None):
+    """Sample every local device's memory stats into gauges and advance
+    the process-wide HBM high-water mark.  Returns
+    ``{"bytes_in_use": max, "peak_bytes_in_use": max, "high_water": hw}``
+    over devices, or {} when no backend reports memory.  Cheap host-only
+    call — safe to run every step."""
+    reg = registry or _metrics.get_registry()
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    in_use_max = peak_max = 0
+    reported = False
+    for i, d in enumerate(devices):
+        stats = device_memory_stats(d)
+        if not stats:
+            continue
+        reported = True
+        in_use = int(stats.get("bytes_in_use", 0))
+        peak = int(stats.get("peak_bytes_in_use", in_use))
+        reg.gauge("device.bytes_in_use", device=str(i)).set(in_use)
+        reg.gauge("device.peak_bytes_in_use", device=str(i)).set(peak)
+        limit = stats.get("bytes_limit")
+        if limit:
+            reg.gauge("device.bytes_limit", device=str(i)).set(int(limit))
+        in_use_max = max(in_use_max, in_use)
+        peak_max = max(peak_max, peak)
+    if not reported:
+        return {}
+    hw = reg.gauge("device.hbm_high_water_bytes")
+    hw.set_max(max(in_use_max, peak_max))
+    return {"bytes_in_use": in_use_max, "peak_bytes_in_use": peak_max,
+            "high_water": hw.value}

@@ -1,0 +1,162 @@
+"""MetricsReporter — a Trainer event handler that turns the step stream
+into telemetry: registry metrics, periodic one-line summaries, and JSONL
+records (`runlog.RunLog`).
+
+    reporter = MetricsReporter(log_every_n=10, jsonl_path="run.jsonl")
+    trainer.train(reader, event_handler=reporter)
+    reporter.close()
+
+Composes with a user handler via ``chain``:
+
+    trainer.train(reader, event_handler=reporter.chain(my_handler))
+
+Events are duck-typed by class name (BeginPass/EndPass/BeginIteration/
+EndIteration) so this module never imports the trainer."""
+
+import sys
+import time
+
+from . import hardware as _hardware
+from . import metrics as _metrics
+from .runlog import RunLog
+
+__all__ = ["MetricsReporter"]
+
+
+class MetricsReporter:
+    """Event handler emitting per-step telemetry.
+
+    * registry: ``trainer.steps`` counter, ``trainer.step_seconds`` /
+      ``trainer.throughput`` histograms, ``trainer.mfu`` gauge, plus the
+      device-memory gauges from ``hardware.sample_memory``;
+    * a one-line summary every ``log_every_n`` steps (0 disables);
+    * one JSONL ``step`` record per iteration and a ``pass`` record per
+      pass when ``jsonl_path`` is given — step records carry wall_time,
+      throughput, compile_count and (when the executor produced cost
+      analysis) flops and MFU.
+    """
+
+    def __init__(self, log_every_n=10, jsonl_path=None, registry=None,
+                 sample_memory_every_n=10, print_fn=None, run_meta=None):
+        self.log_every_n = int(log_every_n)
+        self.sample_memory_every_n = max(1, int(sample_memory_every_n))
+        self.registry = registry or _metrics.get_registry()
+        self.runlog = RunLog(jsonl_path) if jsonl_path else None
+        self._print = print_fn or (lambda s: print(s, file=sys.stderr))
+        self._steps_total = 0
+        self._pass_t0 = None
+        self._pass_samples = 0
+        self._last_mem = {}
+        if self.runlog is not None:
+            self.runlog.log("run_meta", **(run_meta or {}))
+
+    # -- composition -------------------------------------------------------
+    def chain(self, handler):
+        """Wrap a user event handler: telemetry first, then the user's."""
+
+        def both(event):
+            self(event)
+            handler(event)
+
+        return both
+
+    # -- event dispatch ----------------------------------------------------
+    def __call__(self, event):
+        name = type(event).__name__
+        if name == "EndIteration":
+            self._end_iteration(event)
+        elif name == "BeginPass":
+            self._pass_t0 = time.perf_counter()
+            self._pass_samples = 0
+        elif name == "EndPass":
+            self._end_pass(event)
+
+    def _end_iteration(self, ev):
+        reg = self.registry
+        reg.counter("trainer.steps").inc()
+        self._steps_total += 1
+        wall = getattr(ev, "wall_time", None)
+        throughput = getattr(ev, "throughput", None)
+        mfu_v = getattr(ev, "mfu", None)
+        samples = getattr(ev, "samples", None)
+        if wall:
+            reg.histogram("trainer.step_seconds").observe(wall)
+        if throughput:
+            reg.histogram("trainer.throughput").observe(throughput)
+        if mfu_v is not None:
+            reg.gauge("trainer.mfu").set(mfu_v)
+        if samples:
+            self._pass_samples += samples
+        if self._steps_total % self.sample_memory_every_n == 0 or \
+                self._steps_total == 1:
+            self._last_mem = _hardware.sample_memory(reg)
+
+        # the Executor reports its compile/cache counters to the GLOBAL
+        # registry regardless of which registry this reporter writes to
+        compile_count = int(
+            _metrics.get_registry().value("executor.compile_count"))
+        if self.runlog is not None:
+            sc = getattr(ev, "step_cost", None) or {}
+            self.runlog.log(
+                "step",
+                pass_id=ev.pass_id, batch_id=ev.batch_id,
+                step=self._steps_total, cost=ev.cost,
+                wall_time=wall, throughput=throughput, samples=samples,
+                mfu=mfu_v,
+                reader_wait=getattr(ev, "reader_wait", None),
+                compile_count=compile_count,
+                cache_hit=sc.get("cache_hit"),
+                compile_seconds=sc.get("compile_seconds"),
+                flops=sc.get("flops"),
+                bytes_accessed=sc.get("bytes_accessed"),
+                hbm_high_water_bytes=self._last_mem.get("high_water"),
+            )
+        if self.log_every_n and ev.batch_id % self.log_every_n == 0:
+            self._print(self._summary_line(ev, wall, throughput, mfu_v,
+                                           compile_count))
+
+    def _summary_line(self, ev, wall, throughput, mfu_v, compile_count):
+        parts = [f"[pass {ev.pass_id} batch {ev.batch_id}]",
+                 f"cost={float(ev.cost):.6f}"]
+        if wall:
+            parts.append(f"{wall * 1e3:.1f} ms/step")
+        if throughput:
+            parts.append(f"{throughput:.1f} samples/s")
+        if mfu_v is not None:
+            parts.append(f"mfu={mfu_v * 100:.1f}%")
+        parts.append(f"compiles={compile_count}")
+        hw = self._last_mem.get("high_water")
+        if hw:
+            parts.append(f"hbm_hw={hw / (1 << 30):.2f}GiB")
+        return " ".join(parts)
+
+    def _end_pass(self, ev):
+        dt = (time.perf_counter() - self._pass_t0
+              if self._pass_t0 is not None else None)
+        if self.runlog is not None:
+            self.runlog.log(
+                "pass", pass_id=ev.pass_id, wall_time=dt,
+                samples=self._pass_samples,
+                throughput=(self._pass_samples / dt
+                            if dt and self._pass_samples else None),
+                compile_count=int(
+                    self.registry.value("executor.compile_count")),
+            )
+            self.runlog.flush()
+        if self.log_every_n:
+            line = f"[pass {ev.pass_id}] done"
+            if dt:
+                line += f" in {dt:.2f}s"
+                if self._pass_samples:
+                    line += f" ({self._pass_samples / dt:.1f} samples/s)"
+            self._print(line)
+
+    def close(self):
+        if self.runlog is not None:
+            self.runlog.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -9,44 +9,65 @@ On TPU the op loop is compiled away, so per-op host timers are meaningless;
 the equivalents are: (1) the JAX/XLA profiler producing XPlane traces viewed
 in TensorBoard/xprof (``profiler('dir')``), (2) named host-side timers for
 the train loop (``timer`` / ``print_profiler``), and (3) jax debug_nans as
-the check_nan_inf analog (``nan_guard``)."""
+the check_nan_inf analog (``nan_guard``).
+
+Host timers aggregate in the observability metrics registry (histograms
+under the ``host_timer.`` namespace) — ONE aggregation path shared with
+the rest of the telemetry subsystem, so `print_profiler` tables, the
+Prometheus exposition and JSONL run logs all read the same numbers."""
 
 import contextlib
 import time
-from collections import defaultdict
 
 import jax
 
-_records = defaultdict(lambda: [0.0, 0])
+from .observability import metrics as _obs
+
+# registry namespace for host-side phase timers
+TIMER_PREFIX = "host_timer."
 
 
 @contextlib.contextmanager
 def timer(name):
-    """REGISTER_TIMER analog for host-side phases."""
+    """REGISTER_TIMER analog for host-side phases; records into the
+    global metrics registry as ``host_timer.<name>``."""
+    hist = _obs.get_registry().histogram(TIMER_PREFIX + name)
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        dt = time.perf_counter() - t0
-        _records[name][0] += dt
-        _records[name][1] += 1
+        hist.observe(time.perf_counter() - t0)
 
 
 def reset_profiler():
-    _records.clear()
+    """Drop all host timers (registry entries under ``host_timer.``)."""
+    _obs.get_registry().clear(prefix=TIMER_PREFIX)
 
 
 def print_profiler(sorted_key="total"):
-    """PrintProfiler analog: aggregated host timer table."""
+    """PrintProfiler analog: aggregated host timer table with the share
+    of total timed seconds per event.  ``sorted_key`` must be one of
+    ``total`` / ``calls`` / ``ave`` / ``max`` — anything else raises
+    (silently falling back to ``total`` hid typos)."""
+    keys = {"total": 1, "calls": 2, "ave": 3, "max": 4}
+    if sorted_key not in keys:
+        raise ValueError(
+            f"print_profiler: unknown sorted_key {sorted_key!r}; "
+            f"expected one of {sorted(keys)}")
+    hists = _obs.get_registry().metrics(prefix=TIMER_PREFIX)
     rows = [
-        (name, total, calls, total / max(calls, 1))
-        for name, (total, calls) in _records.items()
+        (h.name[len(TIMER_PREFIX):], h.total, h.count, h.mean,
+         (h.max if h.count else 0.0))
+        for h in hists if isinstance(h, _obs.Histogram)
     ]
-    key = {"total": 1, "calls": 2, "ave": 3}.get(sorted_key, 1)
-    rows.sort(key=lambda r: -r[key])
-    out = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Ave(s)':>12}"]
-    for name, total, calls, ave in rows:
-        out.append(f"{name:<40}{calls:>8}{total:>12.4f}{ave:>12.6f}")
+    grand = sum(r[1] for r in rows) or 1.0
+    rows.sort(key=lambda r: -r[keys[sorted_key]])
+    out = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Ave(s)':>12}"
+           f"{'Max(s)':>12}{'%':>8}"]
+    for name, total, calls, ave, mx in rows:
+        out.append(
+            f"{name:<40}{calls:>8}{total:>12.4f}{ave:>12.6f}{mx:>12.6f}"
+            f"{100.0 * total / grand:>8.2f}")
     table = "\n".join(out)
     print(table)
     return table

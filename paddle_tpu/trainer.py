@@ -14,6 +14,8 @@ from .core.executor import Executor
 from .core.program import default_main_program, default_startup_program
 from .core.scope import global_scope
 from .data_feeder import DataFeeder
+from .observability import hardware as _hardware
+from .observability import metrics as _obs
 from . import profiler as _profiler
 from . import io as _io
 
@@ -37,11 +39,35 @@ class BeginIteration:
 
 
 class EndIteration:
-    def __init__(self, pass_id, batch_id, cost, metrics):
+    """End-of-batch event.  Beyond the v2 fields (cost, metrics) it now
+    carries the step telemetry the observability layer reports:
+
+    * ``wall_time``   — host-observed seconds for this batch (feed
+      conversion + device step + fetch materialization);
+    * ``samples``     — batch size (leading dim of the first feed);
+    * ``throughput``  — samples / wall_time;
+    * ``mfu``         — achieved model-FLOPs utilization, from the
+      compiled step's XLA cost analysis over the devices' peak
+      (None when cost analysis is unavailable);
+    * ``reader_wait`` — seconds this step stalled waiting on the input
+      pipeline (prefetch queue empty);
+    * ``step_cost``   — the Executor's ``last_step_cost`` dict
+      (compile_seconds, flops, bytes_accessed, cache_hit).
+    """
+
+    def __init__(self, pass_id, batch_id, cost, metrics, wall_time=None,
+                 samples=None, throughput=None, mfu=None, reader_wait=None,
+                 step_cost=None):
         self.pass_id = pass_id
         self.batch_id = batch_id
         self.cost = cost
         self.metrics = metrics
+        self.wall_time = wall_time
+        self.samples = samples
+        self.throughput = throughput
+        self.mfu = mfu
+        self.reader_wait = reader_wait
+        self.step_cost = step_cost
 
 
 class Trainer:
@@ -61,6 +87,7 @@ class Trainer:
         self.feeder = DataFeeder(feed_list, place)
         self.extra_fetch = extra_fetch or []
         self._initialized = False
+        self._peak_flops_cache = None
 
     def init_params(self):
         self.exe.run(self.startup_program)
@@ -119,11 +146,28 @@ class Trainer:
                 return (b for b in reader())
         ckpt = _io.AsyncCheckpointer() if (
             checkpoint_dir and async_checkpoint) else None
+        reg = _obs.get_registry()
         try:
             for pass_id in range(num_passes):
                 event_handler(BeginPass(pass_id))
-                for batch_id, item in enumerate(batches()):
+                it = iter(batches())
+                batch_id = 0
+                while True:
+                    # reader/feed stall: time spent waiting for the input
+                    # pipeline to produce the next batch.  With prefetch
+                    # this is ~0 unless the producer can't keep up — the
+                    # gauge that diagnoses input-bound runs without xprof.
+                    t_wait = time.perf_counter()
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                    reader_wait = time.perf_counter() - t_wait
+                    reg.gauge("trainer.reader_wait_seconds").set(reader_wait)
+                    reg.counter("trainer.reader_wait_seconds_total").inc(
+                        reader_wait)
                     event_handler(BeginIteration(pass_id, batch_id))
+                    t0 = time.perf_counter()
                     with _profiler.timer("train_batch"):
                         feed = item if prefetch else self.feeder.feed(item)
                         vals = self.exe.run(
@@ -132,15 +176,54 @@ class Trainer:
                             fetch_list=fetch,
                         )
                     cost = float(np.asarray(vals[0]).reshape(-1)[0])
+                    wall = time.perf_counter() - t0
                     metrics = [np.asarray(v) for v in vals[1:]]
-                    event_handler(EndIteration(pass_id, batch_id, cost,
-                                               metrics))
+                    event_handler(EndIteration(
+                        pass_id, batch_id, cost, metrics,
+                        reader_wait=reader_wait,
+                        **self._step_telemetry(wall, feed)))
+                    batch_id += 1
                 self._pass_checkpoint(pass_id, ckpt, checkpoint_dir,
                                       checkpoint_every_n_passes)
                 event_handler(EndPass(pass_id))
         finally:
             if ckpt is not None:
                 ckpt.close()
+
+    def _peak_flops(self):
+        """Aggregate peak FLOP/s of the devices a step runs on (cached)."""
+        if self._peak_flops_cache is None:
+            try:
+                device = (self.exe.place.get_device()
+                          if self.exe.place is not None else None)
+                self._peak_flops_cache = _hardware.total_peak_flops(
+                    mesh=self.exe.mesh, device=device)
+            except Exception:
+                self._peak_flops_cache = 0.0  # unknown: MFU stays None
+        return self._peak_flops_cache
+
+    def _step_telemetry(self, wall, feed, n_batches=1):
+        """EndIteration telemetry kwargs for one batch: wall time,
+        samples (leading feed dim), throughput, and flops-based MFU from
+        the compiled step's cost analysis.  ``n_batches`` divides a fused
+        run_steps group's wall/flops back to per-batch."""
+        samples = None
+        for v in feed.values():
+            shape = getattr(v, "shape", None)
+            if shape:
+                samples = int(shape[0])
+                break
+        wall = wall / max(1, n_batches)
+        out = {"wall_time": wall, "samples": samples,
+               "throughput": (samples / wall if samples and wall > 0
+                              else None),
+               "step_cost": self.exe.last_step_cost, "mfu": None}
+        sc = self.exe.last_step_cost or {}
+        flops = sc.get("flops")
+        if flops and sc.get("steps"):
+            flops = flops / sc["steps"]  # scan executable: whole-group
+        out["mfu"] = _hardware.mfu(flops, wall, self._peak_flops())
+        return out
 
     def _train_fused(self, reader, num_passes, event_handler, checkpoint_dir,
                      checkpoint_every_n_passes, async_checkpoint,
@@ -164,11 +247,11 @@ class Trainer:
                 batch_id = 0
                 pending = []  # [(feed_dict, signature)]
 
-                def emit_end(batch_id, row):
+                def emit_end(batch_id, row, telemetry=None):
                     cost = float(np.asarray(row[0]).reshape(-1)[0])
                     metrics = [np.asarray(v) for v in row[1:]]
                     event_handler(EndIteration(pass_id, batch_id, cost,
-                                               metrics))
+                                               metrics, **(telemetry or {})))
 
                 def flush(pending, batch_id):
                     nonlocal group_n, auto
@@ -218,8 +301,11 @@ class Trainer:
                                         group_n = 1
                                     auto = False
                         del pending[: len(run)]
+                        telemetry = self._step_telemetry(
+                            time.perf_counter() - t0, run[0],
+                            n_batches=len(run))
                         for row in rows:
-                            emit_end(batch_id, row)
+                            emit_end(batch_id, row, telemetry)
                             batch_id += 1
                     return batch_id
 
@@ -232,7 +318,8 @@ class Trainer:
                         vals = self.exe.run(self.main_program, feed=feed,
                                             fetch_list=fetch)
                         single_t.append(time.perf_counter() - t0)
-                        emit_end(batch_id, vals)
+                        emit_end(batch_id, vals,
+                                 self._step_telemetry(single_t[-1], feed))
                         batch_id += 1
                         if len(single_t) >= 4:
                             group_n = 8  # probe phase 2: fused groups

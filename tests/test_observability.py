@@ -1,0 +1,359 @@
+"""Run-telemetry subsystem: registry semantics, JSONL round trip,
+executor compile/cache instrumentation, trainer step telemetry and the
+MetricsReporter event handler."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.observability import (
+    Histogram, MetricsRegistry, MetricsReporter, RunLog, get_registry,
+    hardware, read_jsonl,
+)
+
+
+# -- registry ---------------------------------------------------------------
+def test_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # get-or-create returns the same object
+    assert reg.counter("c") is c
+
+    g = reg.gauge("g", shard="1")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3
+    g.set_max(10)
+    g.set_max(5)
+    assert g.value == 10
+    # labels are part of identity
+    assert reg.gauge("g", shard="2") is not g
+
+    h = reg.histogram("h")
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        h.observe(v)
+    assert h.count == 4 and h.total == 10.0
+    assert h.min == 1.0 and h.max == 4.0 and h.mean == 2.5
+    assert h.percentile(50) == 2.0
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["p50"] == 2.0
+
+    # name re-registered as a different kind is an error
+    with pytest.raises(TypeError):
+        reg.gauge("c")
+
+
+def test_registry_reset_and_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("x.n")
+    h = reg.histogram("x.t")
+    c.inc(5)
+    h.observe(0.25)
+    snap = reg.snapshot()
+    assert snap["x.n"] == 5
+    assert snap["x.t"]["count"] == 1
+    reg.reset()
+    # held handles stay valid and read zero
+    assert c.value == 0 and h.count == 0
+    assert math.isnan(h.percentile(50))
+    reg.clear(prefix="x.t")
+    assert reg.get("x.t") is None and reg.get("x.n") is not None
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("executor.compile_count").inc(3)
+    reg.gauge("master.todo_depth", shard="0").set(7)
+    h = reg.histogram("trainer.step_seconds")
+    for i in range(10):
+        h.observe(0.01 * (i + 1))
+    text = reg.to_text()
+    assert "# TYPE executor_compile_count counter" in text
+    assert "executor_compile_count 3" in text
+    assert 'master_todo_depth{shard="0"} 7' in text
+    assert "# TYPE trainer_step_seconds summary" in text
+    assert 'trainer_step_seconds{quantile="0.5"}' in text
+    assert "trainer_step_seconds_count 10" in text
+    assert "trainer_step_seconds_sum" in text
+
+
+def test_metrics_http_endpoint():
+    import urllib.request
+
+    from paddle_tpu.observability import start_metrics_server
+
+    reg = MetricsRegistry()
+    reg.counter("scrape.me").inc(42)
+    server = start_metrics_server(0, reg)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "scrape_me 42" in body
+    finally:
+        server.shutdown()
+
+
+# -- runlog -----------------------------------------------------------------
+def test_runlog_jsonl_round_trip(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    with RunLog(p) as log:
+        log.log("step", batch_id=0, cost=np.float32(1.5),
+                arr=np.arange(3), nan=float("nan"))
+        log.log("pass", pass_id=0, wall_time=1.25)
+    recs = read_jsonl(p)
+    assert [r["event"] for r in recs] == ["step", "pass"]
+    assert recs[0]["cost"] == 1.5
+    assert recs[0]["arr"] == [0, 1, 2]
+    assert isinstance(recs[0]["nan"], str)  # stringified, not bare NaN
+    assert recs[1]["wall_time"] == 1.25
+    assert read_jsonl(p, event="pass")[0]["pass_id"] == 0
+    # truncated tail line (crashed writer) is tolerated
+    with open(p, "a") as fh:
+        fh.write('{"event": "step", "trunca')
+    assert len(read_jsonl(p)) == 2
+
+
+# -- executor instrumentation ----------------------------------------------
+def _tiny_program():
+    x = layers.data("x", shape=[4])
+    y = layers.fc(x, 2)
+    return x, y
+
+
+def test_executor_compile_counter_and_cache_hit():
+    reg = get_registry()
+    c0 = reg.value("executor.compile_count")
+    _x, y = _tiny_program()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.zeros((2, 4), np.float32)}
+    exe.run(feed=feed, fetch_list=[y])
+    # startup + main step = two fresh compiles
+    assert reg.value("executor.compile_count") >= c0 + 2
+    sc = exe.last_step_cost
+    assert sc["cache_hit"] is False
+    assert sc["compile_seconds"] > 0
+    exe.run(feed=feed, fetch_list=[y])
+    assert exe.last_step_cost["cache_hit"] is True
+    # cache hit does not recompile
+    assert reg.value("executor.compile_count") == c0 + 2
+
+
+def test_executor_cost_analysis_flops():
+    _x, y = _tiny_program()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    exe.run(feed={"x": np.ones((8, 4), np.float32)}, fetch_list=[y])
+    sc = exe.last_step_cost
+    # fc(8x4 @ 4x2) is at least 2*8*4*2 = 128 flops
+    assert sc["flops"] is not None and sc["flops"] >= 128
+    assert sc["bytes_accessed"] is not None and sc["bytes_accessed"] > 0
+
+
+def test_run_steps_records_scan_cost():
+    _x, y = _tiny_program()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((3, 2, 4), np.float32)}
+    exe.run_steps(feed=feed, fetch_list=[y])
+    sc = exe.last_step_cost
+    assert sc["steps"] == 3 and sc["cache_hit"] is False
+    exe.run_steps(feed=feed, fetch_list=[y])
+    assert exe.last_step_cost["cache_hit"] is True
+
+
+# -- hardware accounting ----------------------------------------------------
+def test_mfu_and_peak_flops():
+    assert hardware.mfu(1e9, 0.001, 1e12) == pytest.approx(1.0)
+    assert hardware.mfu(None, 0.001, 1e12) is None
+    assert hardware.mfu(1e9, 0, 1e12) is None
+    # CPU devices resolve to the nominal peak so MFU stays defined
+    import jax
+
+    assert hardware.device_peak_flops(jax.devices()[0]) > 0
+    assert hardware.total_peak_flops() > 0
+
+
+def test_sample_memory_cpu_is_graceful():
+    # CPU backends report no memory stats: no gauges, empty dict, no crash
+    reg = MetricsRegistry()
+    out = hardware.sample_memory(reg)
+    assert out == {} or "bytes_in_use" in out
+
+
+# -- trainer telemetry ------------------------------------------------------
+def _lenet_trainer(extra_fetch=True):
+    from paddle_tpu.models import lenet
+
+    model = lenet.build(learning_rate=0.01)
+    fetch = [model["accuracy"]] if extra_fetch else []
+    return pt.trainer.Trainer(model["avg_cost"], model["feed"],
+                              extra_fetch=fetch)
+
+
+def _mnist_reader(batches=4, batch=8, seed=0):
+    rng = np.random.default_rng(seed)
+
+    def reader():
+        for _ in range(batches):
+            yield [
+                (rng.normal(size=(1, 28, 28)).astype(np.float32),
+                 int(rng.integers(0, 10)))
+                for _ in range(batch)
+            ]
+
+    return reader
+
+
+def test_end_iteration_carries_telemetry():
+    trainer = _lenet_trainer()
+    events = []
+    trainer.train(_mnist_reader(), num_passes=1,
+                  event_handler=lambda e: events.append(e))
+    ends = [e for e in events if isinstance(e, pt.trainer.EndIteration)]
+    assert len(ends) == 4
+    for ev in ends:
+        assert ev.wall_time > 0
+        assert ev.samples == 8
+        assert ev.throughput == pytest.approx(8 / ev.wall_time)
+        assert ev.reader_wait >= 0
+        assert ev.step_cost is not None
+    # first step compiles, later steps hit the cache
+    assert ends[0].step_cost["cache_hit"] is False
+    assert ends[-1].step_cost["cache_hit"] is True
+    # flops-based MFU is defined on CPU (nominal peak) and sane
+    assert ends[-1].mfu is None or 0 <= ends[-1].mfu <= 1.5
+    # reader stall gauge was published
+    assert get_registry().get("trainer.reader_wait_seconds") is not None
+
+
+def test_metrics_reporter_jsonl(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    lines = []
+    reporter = MetricsReporter(log_every_n=2, jsonl_path=p,
+                               print_fn=lines.append)
+    trainer = _lenet_trainer()
+    trainer.train(_mnist_reader(batches=5), num_passes=1,
+                  event_handler=reporter)
+    reporter.close()
+    steps = read_jsonl(p, event="step")
+    assert len(steps) == 5
+    for rec in steps:
+        assert rec["wall_time"] > 0
+        assert rec["throughput"] > 0
+        assert rec["samples"] == 8
+        assert rec["compile_count"] >= 1
+        assert "mfu" in rec and "reader_wait" in rec
+    assert steps[0]["cache_hit"] is False
+    assert steps[-1]["cache_hit"] is True
+    passes = read_jsonl(p, event="pass")
+    assert len(passes) == 1 and passes[0]["samples"] == 40
+    # periodic one-line summaries fired (batches 0, 2, 4 + pass line)
+    assert sum("cost=" in ln for ln in lines) == 3
+
+
+def test_metrics_reporter_chain(tmp_path):
+    seen = []
+    reporter = MetricsReporter(log_every_n=0,
+                               jsonl_path=str(tmp_path / "r.jsonl"))
+    trainer = _lenet_trainer()
+    trainer.train(_mnist_reader(batches=2), num_passes=1,
+                  event_handler=reporter.chain(seen.append))
+    reporter.close()
+    assert sum(isinstance(e, pt.trainer.EndIteration) for e in seen) == 2
+
+
+# -- profiler fold-in -------------------------------------------------------
+def test_print_profiler_percent_column_and_strict_key(capsys):
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    with profiler.timer("phase_a"):
+        pass
+    with profiler.timer("phase_a"):
+        pass
+    with profiler.timer("phase_b"):
+        pass
+    table = profiler.print_profiler(sorted_key="calls")
+    assert "%" in table.splitlines()[0]
+    assert "phase_a" in table and "phase_b" in table
+    # one aggregation path: the same timers live in the metrics registry
+    h = get_registry().get("host_timer.phase_a")
+    assert h is not None and h.count == 2
+    with pytest.raises(ValueError):
+        profiler.print_profiler(sorted_key="bogus")
+    profiler.reset_profiler()
+    assert get_registry().get("host_timer.phase_a") is None
+
+
+# -- distributed surfaces ---------------------------------------------------
+def test_master_metrics_surface(tmp_path):
+    from paddle_tpu.distributed.master import MasterService
+    from paddle_tpu.native import recordio
+
+    path = str(tmp_path / "data.rio")
+    w = recordio.Writer(path)
+    for i in range(4):
+        w.write(f"rec{i}".encode())
+    w.close()
+
+    # own registry: the global one accumulates across the suite's other
+    # distributed tests
+    svc = MasterService(timeout_sec=60, registry=MetricsRegistry())
+    svc.set_dataset([path])
+    m = svc.metrics()
+    assert m["todo_depth"] >= 1 and m["pending_depth"] == 0
+    task = svc.get_task()
+    m = svc.metrics()
+    assert m["pending_depth"] == 1
+    assert m["tasks_dispatched"] == 1
+    svc.task_finished(task["id"])
+    m = svc.metrics()
+    assert m["tasks_finished"] == 1 and m["pending_depth"] == 0
+    assert m["last_contact_age_sec"] < 60
+
+
+def test_pserver_metrics_surface():
+    from paddle_tpu.distributed.pserver import ParameterServer, PServerClient
+
+    ps = ParameterServer(index=0, num_trainers=1,
+                         registry=MetricsRegistry())
+    with PServerClient([ps]) as client:
+        client.init_params({"w": np.zeros((4, 2), np.float32)},
+                           optimizer="sgd", lr=0.1)
+        client.send_grads({"w": np.ones((4, 2), np.float32)})
+        m = ps.metrics()
+    assert m["param_count"] == 1
+    assert m["param_bytes"] == 4 * 2 * 4
+    assert m["updates_applied"] == 1
+    assert m["grads_received"] == 1
+    assert m["last_update_age_sec"] < 60
+
+
+# -- inference latency ------------------------------------------------------
+def test_inference_engine_latency_histogram(tmp_path):
+    reg = get_registry()
+    reg.clear(prefix="inference.")
+    d = str(tmp_path / "model")
+    x, y = _tiny_program()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.io.save_inference_model(d, ["x"], [y], exe)
+    engine = pt.inference.InferenceEngine(d)
+    for _ in range(3):
+        engine.run(feed={"x": np.zeros((1, 4), np.float32)})
+    assert reg.value("inference.requests") == 3
+    h = reg.get("inference.run_seconds")
+    assert h is not None and h.count == 3
+    assert h.snapshot()["p50"] > 0

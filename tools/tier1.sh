@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Tier-1 verify with a DOTS_PASSED regression gate.
+#
+# Runs the ROADMAP.md tier-1 pytest command, counts passed tests the same
+# way the driver does (dots in the progress lines), and fails if the count
+# drops below the floor recorded in tests/TIER1_FLOOR.  Raise the floor
+# whenever a PR adds passing tests; never lower it.
+#
+# Usage: tools/tier1.sh
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+floor=$(cat tests/TIER1_FLOOR 2>/dev/null || echo 0)
+echo "DOTS_PASSED=$passed (floor: $floor)"
+if [ "$passed" -lt "$floor" ]; then
+    echo "TIER1 REGRESSION: DOTS_PASSED $passed < floor $floor" >&2
+    exit 1
+fi
+# the metrics-selftest smoke entry rides along: the telemetry subsystem
+# must stay healthy for every perf PR that reads it
+if ! python -m paddle_tpu --metrics-selftest > /tmp/_t1_selftest.log 2>&1; then
+    echo "TIER1 REGRESSION: metrics selftest failed" >&2
+    cat /tmp/_t1_selftest.log >&2
+    exit 1
+fi
+exit $rc
