@@ -1,0 +1,227 @@
+"""Serving benchmark — the continuous-batching engine under load.
+
+Drives ``paddle_tpu.serving.ServingEngine`` with a mixed-length request
+workload (optionally Poisson arrivals) and measures it against the
+sequential single-request baseline — each request run alone, one at a
+time, through the existing single-stream KV-cache decode
+(``models/transformer.py generate``), the serving story before this
+engine existed.  Both sides run in the same process on the same weights
+in the same run, post-compile.
+
+Emits exactly ONE parseable JSON line on stdout (everything else goes to
+stderr; on any failure the line carries an ``error`` field — the PR-1
+bench discipline: never die without a parseable row):
+
+    tok_s            aggregate generated tokens/sec through the engine
+    baseline_tok_s   same workload, sequential single-stream decode
+    speedup          tok_s / baseline_tok_s
+    ttft_p50/95/99_ms, e2e_p50/95/99_ms   per-request latency (handles)
+    prefill_compiles / decode_compiles / buckets   the compile bound:
+                     executables == used prefill buckets + 1 decode
+                     chunk, independent of request count
+
+``--smoke`` is the CI gate (tools/tier1.sh): a CPU-sized config at
+concurrency >= 8 that ASSERTS the engine beats the sequential baseline
+and that the compile bound holds.
+
+Usage:
+    python benchmarks/serving.py --smoke
+    python benchmarks/serving.py --requests 64 --rate 8   # Poisson load
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_params(vocab, n_layer, n_head, d_model, max_len, dtype):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        transformer.build(vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+                          d_model=d_model, max_len=max_len,
+                          dropout_rate=0.0, is_test=True, dtype=dtype)
+    exe = pt.Executor()
+    exe.run(startup)
+    return transformer.extract_params(program=main)
+
+
+def make_workload(rng, n, classes, vocab):
+    """n requests cycling through (prompt_len, max_new) classes — the
+    mixed-length traffic continuous batching exists for."""
+    return [
+        (rng.integers(1, vocab, (classes[i % len(classes)][0],))
+         .astype(np.int32), classes[i % len(classes)][1])
+        for i in range(n)
+    ]
+
+
+def run_baseline(params, cfg, work):
+    """Sequential single-request serving on the pre-engine path: one
+    ``transformer.generate`` call per request (its exact total length),
+    next request only after the previous finishes.  Jit-cached per
+    (p_len, total) shape; compile paid OUTSIDE the timed window."""
+    import jax
+
+    from paddle_tpu.models import transformer
+
+    nl, nh, dm = cfg["n_layer"], cfg["n_head"], cfg["d_model"]
+    gens = {}
+    for p, m in work:
+        key = (p.shape[0], p.shape[0] + m)
+        if key not in gens:
+            gens[key] = jax.jit(
+                lambda ps, pr, total=key[1]: transformer.generate(
+                    ps, pr, total, nl, nh, dm, return_logits=False)[0])
+    import jax.numpy as jnp
+
+    pdev = jax.device_put({k: jnp.asarray(v) for k, v in params.items()})
+    warmed = set()
+    for p, m in work:  # warm one request per distinct shape
+        key = (p.shape[0], p.shape[0] + m)
+        if key not in warmed:
+            warmed.add(key)
+            np.asarray(gens[key](pdev, p[None]))
+    t0 = time.perf_counter()
+    for p, m in work:
+        np.asarray(gens[(p.shape[0], p.shape[0] + m)](pdev, p[None]))
+    wall = time.perf_counter() - t0
+    new_toks = sum(m for _, m in work)
+    return {"baseline_tok_s": new_toks / wall,
+            "baseline_wall_s": wall,
+            "baseline_shapes": len(gens)}
+
+
+def run_engine(params, cfg, work, rate, rng):
+    """Timed engine run; returns throughput + per-request latency from
+    the request handles.  Compiles (prefill buckets + decode chunk) are
+    paid by a warm pass over one request per bucket."""
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(
+        params, cfg["n_layer"], cfg["n_head"], cfg["d_model"],
+        max_len=cfg["max_len"], max_slots=cfg["slots"],
+        decode_chunk=cfg["chunk"], min_bucket=cfg["min_bucket"])
+    # warm: one tiny request per distinct bucket + the decode chunk
+    seen = {}
+    for p, _ in work:
+        seen.setdefault(eng.bucket_for(p.shape[0]), p)
+    eng.generate_many(list(seen.values()), max_new_tokens=2)
+
+    prompts = [p for p, _ in work]
+    max_new = [m for _, m in work]
+    t0 = time.perf_counter()
+    if rate:
+        eng.start()
+        reqs = []
+        for p, m in zip(prompts, max_new):
+            reqs.append(eng.submit(p, m))
+            time.sleep(rng.exponential(1.0 / rate))
+        for r in reqs:
+            r.wait()
+        eng.stop()
+    else:
+        reqs = [eng.submit(p, m) for p, m in zip(prompts, max_new)]
+        eng.run_until_idle()
+    wall = time.perf_counter() - t0
+    st = eng.stats()
+    ttft = np.asarray([r.ttft for r in reqs]) * 1e3
+    e2e = np.asarray([r.e2e for r in reqs]) * 1e3
+    out = {"tok_s": sum(max_new) / wall, "wall_s": wall,
+           "prefill_compiles": int(st["serving.prefill_compiles"]),
+           "decode_compiles": int(st["serving.decode_compiles"]),
+           "buckets": sorted(seen)}
+    for name, arr in (("ttft", ttft), ("e2e", e2e)):
+        for q in (50, 95, 99):
+            out[f"{name}_p{q}_ms"] = round(float(np.percentile(arr, q)), 2)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU-sized CI gate: assert engine > sequential "
+                    "baseline at concurrency >= 8 and the compile bound")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--rate", type=float, default=None,
+                    help="Poisson arrival rate (req/s); omit = all "
+                    "requests queued up front")
+    ap.add_argument("--slots", type=int, default=None)
+    ap.add_argument("--chunk", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-baseline", action="store_true")
+    args = ap.parse_args()
+
+    if args.smoke:
+        # sized so the batched-decode win is visible on a CPU backend:
+        # wide head (the b=1 lm_head matmul is the single-stream path's
+        # wasted bandwidth), decode-heavy mix, concurrency 16
+        cfg = {"vocab": 8192, "n_layer": 2, "n_head": 8, "d_model": 512,
+               "max_len": 64, "slots": 16, "chunk": 8, "min_bucket": 4,
+               "classes": [(4, 44), (6, 56), (8, 48)], "requests": 24,
+               "dtype": "float32"}
+    else:
+        cfg = {"vocab": 32768, "n_layer": 12, "n_head": 6, "d_model": 768,
+               "max_len": 512, "slots": 32, "chunk": 16, "min_bucket": 16,
+               "classes": [(16, 96), (32, 192), (64, 256), (24, 480)],
+               "requests": 64, "dtype": "bfloat16"}
+    if args.requests:
+        cfg["requests"] = args.requests
+    if args.slots:
+        cfg["slots"] = args.slots
+    if args.chunk:
+        cfg["chunk"] = args.chunk
+
+    row = {"metric": "serving_tok_s", "mode": "smoke" if args.smoke
+           else "load", "requests": cfg["requests"], "slots": cfg["slots"],
+           "chunk": cfg["chunk"], "rate": args.rate,
+           "model": f"l{cfg['n_layer']}_d{cfg['d_model']}_v{cfg['vocab']}"}
+    try:
+        rng = np.random.default_rng(args.seed)
+        log(f"building model {row['model']} ...")
+        params = build_params(cfg["vocab"], cfg["n_layer"], cfg["n_head"],
+                              cfg["d_model"], cfg["max_len"], cfg["dtype"])
+        work = make_workload(rng, cfg["requests"], cfg["classes"],
+                             cfg["vocab"])
+        log(f"engine run: {cfg['requests']} requests, "
+            f"{cfg['slots']} slots, chunk {cfg['chunk']}, "
+            f"rate {args.rate or 'batch'}")
+        row.update(run_engine(params, cfg, work, args.rate, rng))
+        if not args.no_baseline:
+            log("sequential single-stream baseline ...")
+            row.update(run_baseline(params, cfg, work))
+            row["speedup"] = round(row["tok_s"] / row["baseline_tok_s"], 2)
+        row["tok_s"] = round(row["tok_s"], 1)
+        if "baseline_tok_s" in row:
+            row["baseline_tok_s"] = round(row["baseline_tok_s"], 1)
+
+        if args.smoke:
+            assert cfg["slots"] >= 8 and cfg["requests"] >= 8
+            n_buckets = len(row["buckets"])
+            assert (row["prefill_compiles"] + row["decode_compiles"]
+                    <= n_buckets + 1), \
+                f"compile bound violated: {row}"
+            assert row["speedup"] > 1.0, \
+                (f"continuous batching did not beat sequential decode: "
+                 f"{row}")
+    except Exception as e:  # noqa: BLE001 — the row must still print
+        row["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(row))
+        raise
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -735,8 +735,10 @@ class Executor:
 
         # additive combiners through which batch-sum-ness propagates
         # linearly: sum(microbatch values) reassembles the big-batch value
-        _ADDITIVE = {"elementwise_add", "elementwise_sub", "sum", "sums",
-                     "scale"}
+        # (layers.sums appends op type "sum", so no "sums" entry exists)
+        _ADDITIVE = {"elementwise_add", "elementwise_sub", "sum", "scale"}
+        _bs_memo = {}
+        _bs_cap_hits = [0]
 
         def _is_batch_sum(name, _depth=0):
             """Transitive classification: True when the fetch is a pure
@@ -744,9 +746,25 @@ class Executor:
             an additive composite of such), so the big-batch value is the
             SUM of the microbatch values.  A composite mixing sum-like and
             non-sum-like terms has no exact reassembly — raise rather than
-            silently return 1/accum of the truth."""
+            silently return 1/accum of the truth.  Memoized per var name:
+            a shared-subexpression additive DAG (x = x + x doubling) is
+            linear work, not exponential.  A result whose subtree hit the
+            depth cap is conservative-for-this-path, not a property of
+            the var — it must NOT be memoized, or a later shallower query
+            would read the poisoned value (the cap-hit counter detects
+            taint anywhere in the subtree, short-circuiting included)."""
             if _depth > 64:
-                return False
+                _bs_cap_hits[0] += 1
+                return False  # depth-capped: conservative
+            if name in _bs_memo:
+                return _bs_memo[name]
+            before = _bs_cap_hits[0]
+            r = _is_batch_sum_uncached(name, _depth)
+            if _bs_cap_hits[0] == before:
+                _bs_memo[name] = r
+            return r
+
+        def _is_batch_sum_uncached(name, _depth):
             op = producer.get(name)
             if op is None:
                 return False

@@ -39,12 +39,18 @@ class DataFeeder:
             # small-dim compatibility path; declare the var with
             # layers.sparse_data to stay sparse).  Sequence slots (cells
             # are lists of SparseRow) densify to [t, dim] rows and fall
-            # through to the normal lod padding below.
-            if col and isinstance(col[0], SparseRow):
+            # through to the normal lod padding below.  Detection scans
+            # for ANY sparse cell — sniffing only col[0] would skip
+            # densification whenever the first sample happens to be an
+            # empty sequence, crashing later in the lod padding path.
+            kind, dim = self._sparse_kind(col)
+            if kind == "row":
                 col = [c.todense() for c in col]
-            elif (col and isinstance(col[0], (list, tuple)) and col[0]
-                  and isinstance(col[0][0], SparseRow)):
-                col = [np.stack([r.todense() for r in c]) for c in col]
+            elif kind == "seq":
+                # empty sequences densify to [0, dim] so the lod padding
+                # below sees a consistent feature shape
+                col = [np.stack([r.todense() for r in c]) if len(c)
+                       else np.zeros((0, dim), np.float32) for c in col]
             if getattr(var, "lod_level", 0) > 1:
                 self._feed_nested(var, col, result)
             elif getattr(var, "lod_level", 0) > 0:
@@ -78,6 +84,27 @@ class DataFeeder:
                     arr = arr[..., None]  # fluid's trailing [.,1] label shape
                 result[var.name] = arr
         return result
+
+    @staticmethod
+    def _sparse_kind(col):
+        """Classify a column by its first UNAMBIGUOUS cell: ("row", dim)
+        — cells are SparseRow samples; ("seq", dim) — cells are sequences
+        of SparseRow; (None, None) — not sparse.  Only empty sequences
+        are ambiguous (they say nothing about the inner type), so this
+        stays O(1) on dense columns while still classifying a batch whose
+        first cells are empty sparse sequences."""
+        for c in col:
+            if isinstance(c, SparseRow):
+                return "row", c.dim
+            if isinstance(c, (list, tuple)):
+                if not c:
+                    continue  # empty sequence: keep scanning
+                if isinstance(c[0], SparseRow):
+                    return "seq", c[0].dim
+                return None, None  # ordinary nested list
+            else:
+                return None, None  # dense cell: not a sparse column
+        return None, None
 
     def _feed_sparse(self, var, col, result):
         """Native sparse slot: pad each sample's (ids, vals) to the batch

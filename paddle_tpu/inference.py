@@ -1,5 +1,13 @@
 """Inference engine (reference: paddle/inference/inference.{h,cc} — load
-__model__ + persistables, then Executor::Run; v2 inference.py infer())."""
+__model__ + persistables, then Executor::Run; v2 inference.py infer()).
+
+This is the one-shot Program-forward path (load an exported model dir,
+feed, fetch).  For multi-tenant autoregressive LLM serving — many
+concurrent variable-length decode requests over the flagship
+transformer — use ``paddle_tpu.serving.ServingEngine`` (continuous
+batching over the batched KV cache; ``docs/serving.md``), which
+multiplexes requests into one compiled decode step instead of running
+one Program per caller."""
 
 import time
 

@@ -117,6 +117,42 @@ def extract_params(scope=None, program=None):
     }
 
 
+def infer_compute_dtype(params):
+    """The serving dtype the weights imply: the narrowest floating dtype
+    among the transformer-block / lm_head MATMUL weights (``block*...w`` /
+    ``lm_head.w``).  The embedding tables are deliberately f32 in training
+    (master-precision rows, cast after gather), so they must not promote
+    the decode; conversely a stray low-precision adapter matrix somewhere
+    else in the dict (an fp8/f16 LoRA bolted on later) must not silently
+    downgrade the whole decode and its KV caches — hence the scan is
+    restricted to the block/head weights that actually feed the MXU.
+    Falls back to any >=2-D floating weight when no block/head names
+    match (renamed or weight-tied heads), then float32."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    def _mats(keys):
+        # metadata-only inspection: never jnp.asarray the weights here
+        # (that would device-transfer every array just to read dtypes)
+        out = []
+        for k in keys:
+            v = params[k]
+            if not (hasattr(v, "dtype") and hasattr(v, "shape")):
+                v = np.asarray(v)
+            if len(v.shape) >= 2 and jnp.issubdtype(v.dtype, jnp.floating):
+                out.append(jnp.dtype(v.dtype))
+        return out
+
+    mats = _mats([k for k in params
+                  if (k.startswith("block") or k.startswith("lm_head"))
+                  and k.endswith(".w")])
+    if not mats:
+        mats = _mats(list(params))
+    return (min(mats, key=lambda d: jnp.dtype(d).itemsize)
+            if mats else jnp.float32)
+
+
 def generate(params, prompt, max_len, n_layer, n_head, d_model,
              temperature=0.0, key=None, eps=1e-5, compute_dtype=None,
              return_logits=True):
@@ -154,18 +190,10 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
     if temperature and key is None:
         raise ValueError("temperature > 0 sampling requires a PRNG `key`")
     if compute_dtype is None:
-        # the big matmul weights decide the serving dtype; the embedding
-        # tables are deliberately f32 in training (master-precision rows,
-        # cast after gather) and result_type over all params would let
-        # them promote the whole decode (and its KV caches) to f32.
-        # Rule: the narrowest floating dtype among the >=2-D weights —
-        # robust to head/naming variations (a weight-tied or renamed head
-        # must not silently fall back to the f32 embedding's dtype).
-        mats = [jnp.asarray(v).dtype for v in params.values()
-                if jnp.asarray(v).ndim >= 2
-                and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)]
-        compute_dtype = (min(mats, key=lambda d: jnp.dtype(d).itemsize)
-                         if mats else jnp.float32)
+        # the block/lm_head matmul weights decide the serving dtype
+        # (see infer_compute_dtype: f32 embedding tables must not promote
+        # the decode, stray low-precision adapters must not downgrade it)
+        compute_dtype = infer_compute_dtype(params)
     p = {k: jnp.asarray(v, compute_dtype) for k, v in params.items()}
     b, p_len = prompt.shape
     dh = d_model // n_head

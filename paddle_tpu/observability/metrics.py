@@ -144,27 +144,25 @@ class Histogram(_Metric):
     def mean(self):
         return self.total / self.count if self.count else 0.0
 
+    @staticmethod
+    def _rank(s, p):
+        """Nearest-rank value for percentile ``p`` over sorted ``s``."""
+        idx = min(len(s) - 1, max(0, int(math.ceil(p / 100.0 * len(s))) - 1))
+        return s[idx]
+
     def percentile(self, p):
         """p in [0, 100]; nearest-rank over the reservoir.  NaN when
         nothing has been observed."""
         with self._lock:
             s = sorted(self._samples)
-        if not s:
-            return math.nan
-        idx = min(len(s) - 1, max(0, int(math.ceil(p / 100.0 * len(s))) - 1))
-        return s[idx]
+        return self._rank(s, p) if s else math.nan
 
     def percentiles(self, ps=(50, 95, 99)):
         with self._lock:
             s = sorted(self._samples)
         if not s:
             return {p: math.nan for p in ps}
-        out = {}
-        for p in ps:
-            idx = min(len(s) - 1,
-                      max(0, int(math.ceil(p / 100.0 * len(s))) - 1))
-            out[p] = s[idx]
-        return out
+        return {p: self._rank(s, p) for p in ps}
 
     def snapshot(self):
         with self._lock:
@@ -176,9 +174,7 @@ class Histogram(_Metric):
         if count:
             out["min"], out["max"] = mn, mx
             for p in (50, 95, 99):
-                idx = min(len(s) - 1,
-                          max(0, int(math.ceil(p / 100.0 * len(s))) - 1))
-                out[f"p{p}"] = s[idx]
+                out[f"p{p}"] = self._rank(s, p)
         return out
 
     def reset(self):

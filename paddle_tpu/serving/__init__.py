@@ -1,0 +1,15 @@
+"""Continuous-batching serving — multi-request decode over the flagship
+transformer's KV-cache serving path (`docs/serving.md`).
+
+``ServingEngine`` keeps one fixed-capacity batched decode step (compiled
+once) saturated across many concurrent, variable-length requests: a slot
+pool over the batched KV cache, admission between decode chunks
+(continuous batching), power-of-two shape-bucketed prefill so compile
+count is bounded by the bucket set, and full ``serving.*`` telemetry
+through the observability registry.
+"""
+
+from . import batched_decode
+from .engine import Request, ServingEngine
+
+__all__ = ["Request", "ServingEngine", "batched_decode"]

@@ -1,0 +1,169 @@
+"""Slot-batched KV-cache decode — the pure-JAX compute under
+``paddle_tpu.serving``.
+
+The batched cache holds ``max_slots`` independent sequences: tuples of
+``n_layer`` ``[S, T, h, dh]`` arrays plus per-slot scalars (``last_tok``
+[S] int32, ``pos`` [S] int32).  Slot rows never interact — every op here
+is row-wise (matmuls, layer norm, per-slot causal attention, per-row
+argmax), so slot ``s`` computes exactly what ``models/transformer.py
+generate`` computes at position ``pos[s]`` and greedy decode is
+token-identical to the single-stream path (the serving acceptance bar).
+
+Three compiled entry points, built once per engine:
+
+* ``make_decode_chunk`` — ONE executable for the whole engine lifetime:
+  a ``lax.scan`` of ``chunk`` batched steps between host syncs, so the
+  per-call dispatch+sync cost amortizes over ``chunk`` tokens for every
+  active slot at once.
+* ``make_prefill`` — one executable PER SHAPE BUCKET (prompt padded to a
+  power-of-two length): scans the prompt through the same step math,
+  building a fresh ``[T, h, dh]`` cache row, then writes the whole row
+  into the batched cache at the target slot.  Compile count is bounded
+  by the bucket set, never the request count.
+
+Prefill deliberately reuses the single-token step (a scan over the
+bucket) instead of a full-sequence teacher-forced matmul: the scan is
+bit-identical to the reference decode (same per-row reduction shapes),
+which is what makes the engine's outputs provably equal to running each
+request alone.  Steps past the real prompt length process padding and
+write garbage K/V at positions >= length — harmless by construction:
+decode writes position ``pos`` BEFORE attending (mask ``<= pos``), so a
+garbage position is always overwritten before it is ever attended.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["batched_step_logits", "make_decode_chunk", "make_prefill"]
+
+
+def _ln(x, scale, bias, eps):
+    # statistics in f32 even under bf16 compute (mean/var cancellation) —
+    # mirrors transformer.generate's ln exactly
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    xn = ((x32 - mu) / jnp.sqrt(var + eps)).astype(x.dtype)
+    return xn * scale + bias
+
+
+def batched_step_logits(p, tok, t, cache_k, cache_v, n_layer, n_head,
+                        d_model, eps=1e-5):
+    """One decode step for S independent slots.
+
+    tok [S] int32 current tokens, t [S] int32 per-slot positions,
+    cache_k/cache_v tuples of n_layer [S, T, h, dh].  Writes each slot's
+    K/V at its own position ``t_s`` (clamped to the cache), attends over
+    positions ``<= t_s``, and returns ``(logits [S, vocab] f32, cache_k',
+    cache_v')``.
+    """
+    S = tok.shape[0]
+    T = cache_k[0].shape[1]
+    dh = d_model // n_head
+    rows = jnp.arange(S)
+    tw = jnp.clip(t, 0, T - 1)  # overrun slots write in-bounds garbage
+    x = p["tok_emb.w"][tok] + p["pos_emb.w.w"][tw]          # [S, d]
+    ck_out, cv_out = [], []
+    for i in range(n_layer):
+        w = lambda nm: p[f"block{i}_{nm}"]
+        h = _ln(x, w("ln1.scale"), w("ln1.bias"), eps)
+        q = h @ w("att_q.w") + w("att_q.b")
+        k = h @ w("att_k.w") + w("att_k.b")
+        v = h @ w("att_v.w") + w("att_v.b")
+        qh = q.reshape(S, n_head, dh)
+        kh = k.reshape(S, n_head, dh)
+        vh = v.reshape(S, n_head, dh)
+        # per-slot scatter: slot s writes at its own position t_s
+        ck = cache_k[i].at[rows, tw].set(kh)
+        cv = cache_v[i].at[rows, tw].set(vh)
+        ck_out.append(ck)
+        cv_out.append(cv)
+        s = jnp.einsum("shd,sThd->shT", qh, ck,
+                       preferred_element_type=jnp.float32)
+        s = s / jnp.sqrt(float(dh))
+        mask = jnp.arange(T)[None, None, :] <= t[:, None, None]
+        s = jnp.where(mask, s, -1e30)
+        a = jax.nn.softmax(s, axis=-1).astype(ck.dtype)
+        ctx = jnp.einsum("shT,sThd->shd", a, cv).reshape(S, d_model)
+        x = x + ctx @ w("att_out.w") + w("att_out.b")
+        h2 = _ln(x, w("ln2.scale"), w("ln2.bias"), eps)
+        ff = jax.nn.gelu(h2 @ w("ffn1.w") + w("ffn1.b"))
+        x = x + ff @ w("ffn2.w") + w("ffn2.b")
+    x = _ln(x, p["ln_f.scale"], p["ln_f.bias"], eps)
+    logits = jnp.matmul(x, p["lm_head.w"],
+                        preferred_element_type=jnp.float32)
+    return logits, tuple(ck_out), tuple(cv_out)
+
+
+def make_decode_chunk(n_layer, n_head, d_model, chunk, eps=1e-5,
+                      donate=True):
+    """Build the batched decode executable: ``chunk`` greedy steps for
+    every slot in one device call.
+
+    ``fn(params, cache_k, cache_v, last_tok, pos) -> (cache_k', cache_v',
+    last_tok', pos', toks [chunk, S] int32)`` — ``toks[j]`` is the token
+    each slot emitted at its ``pos+j``'th position.  The caches and slot
+    scalars are donated (updated in place on TPU); callers must replace
+    their references with the outputs.
+    """
+
+    def decode_chunk(p, cache_k, cache_v, last_tok, pos):
+        def body(carry, _):
+            ck, cv, tok, t = carry
+            logits, ck, cv = batched_step_logits(
+                p, tok, t, ck, cv, n_layer, n_head, d_model, eps)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (ck, cv, nxt, t + 1), nxt
+
+        (ck, cv, tok, t), toks = jax.lax.scan(
+            body, (cache_k, cache_v, last_tok, pos), None, length=chunk)
+        return ck, cv, tok, t, toks
+
+    return jax.jit(decode_chunk,
+                   donate_argnums=(1, 2, 3, 4) if donate else ())
+
+
+def make_prefill(n_layer, n_head, d_model, bucket, max_len, eps=1e-5,
+                 donate=True):
+    """Build the prefill executable for one prompt-length bucket.
+
+    ``fn(params, cache_k, cache_v, last_tok, pos, slot, prompt [bucket],
+    length) -> (cache_k', cache_v', last_tok', pos', first_tok)`` —
+    scans the padded prompt through the step math on a fresh zero cache
+    row, writes the row into the batched cache at ``slot``, seeds the
+    slot's ``last_tok`` with the first generated token (greedy argmax at
+    the last real prompt position, ``length - 1``) and ``pos`` with
+    ``length``.  ``first_tok`` is also returned as a scalar so the
+    scheduler can report TTFT / detect an immediate EOS without pulling
+    the whole slot state back.
+    """
+    dh = d_model // n_head
+
+    def prefill(p, cache_k, cache_v, last_tok, pos, slot, prompt, length):
+        dtype = cache_k[0].dtype
+        row_k = tuple(jnp.zeros((1, max_len, n_head, dh), dtype)
+                      for _ in range(n_layer))
+        row_v = tuple(jnp.zeros((1, max_len, n_head, dh), dtype)
+                      for _ in range(n_layer))
+
+        def body(carry, t):
+            ck, cv = carry
+            tok = jax.lax.dynamic_slice_in_dim(prompt, t, 1)  # [1]
+            logits, ck, cv = batched_step_logits(
+                p, tok, t[None], ck, cv, n_layer, n_head, d_model, eps)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (ck, cv), nxt[0]
+
+        (row_k, row_v), nxts = jax.lax.scan(
+            body, (row_k, row_v), jnp.arange(bucket))
+        first = jax.lax.dynamic_index_in_dim(nxts, length - 1,
+                                             keepdims=False)
+        cache_k = tuple(c.at[slot].set(r[0])
+                        for c, r in zip(cache_k, row_k))
+        cache_v = tuple(c.at[slot].set(r[0])
+                        for c, r in zip(cache_v, row_v))
+        last_tok = last_tok.at[slot].set(first)
+        pos = pos.at[slot].set(length)
+        return cache_k, cache_v, last_tok, pos, first
+
+    return jax.jit(prefill, donate_argnums=(1, 2, 3, 4) if donate else ())

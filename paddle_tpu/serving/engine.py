@@ -1,0 +1,484 @@
+"""Continuous-batching serving engine — slot-scheduled multi-request
+decode over the flagship transformer's KV-cache serving path.
+
+``models/transformer.py generate`` turned decode into a single jitted
+scan, but it serves exactly one request per call: chip utilization
+collapses under real traffic (many concurrent, variable-length
+requests).  Decode is HBM-bandwidth-bound on WEIGHT reads, so batching
+``S`` requests into one step re-reads the same weights for ``S`` tokens
+— nearly free throughput.  The engine keeps one fixed-capacity batched
+decode step saturated across many requests:
+
+* **Slot pool** — the batched KV cache has ``max_slots`` rows; each row
+  holds one active sequence with its own length (``pos``).  A slot is
+  freed the moment its request hits EOS or its token budget, and the
+  row is fully overwritten by the next prefill (stale K/V is never
+  attended: decode writes position ``pos`` before masking ``<= pos``).
+* **Continuous batching** — queued requests are admitted into free
+  slots BETWEEN decode chunks, not at batch boundaries: a long request
+  never holds the batch hostage, a short one never waits for stragglers.
+* **Bucketed prefill** — prompts pad to the nearest power-of-two bucket
+  so the compile cache is bounded by the bucket set (TVM-style static
+  shape buckets), never by the request count: total executables =
+  ``len(used prefill buckets) + 1`` decode chunk.
+* **Chunked decode** — ``decode_chunk`` steps run per device call
+  (one ``lax.scan``), amortizing dispatch + host sync over
+  ``chunk × active_slots`` tokens.  EOS is detected on the host after
+  the chunk; a slot finishing mid-chunk wastes at most ``chunk - 1``
+  garbage steps (discarded, never surfaced).
+
+Greedy decode through the engine is token-identical to running each
+request alone through ``transformer.generate`` (same per-row math; see
+``batched_decode``).  Telemetry flows through the global observability
+registry under ``serving.*`` (queue depth, slot occupancy, admitted /
+completed / token counters, TTFT + per-step + e2e histograms, tok/s
+gauge, compile counters).
+"""
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from ..observability import metrics as _obs
+from . import batched_decode as _bd
+
+__all__ = ["Request", "ServingEngine"]
+
+
+class Request:
+    """One submitted generation request and its (eventual) result.
+
+    ``tokens`` holds only GENERATED tokens (EOS included when hit);
+    ``result()`` returns prompt + generated as one int32 array.  Handles
+    are thread-safe: ``wait``/``result`` may be called from any thread
+    while the engine runs in another.  If the engine aborts (a device
+    error mid-serve), the handle completes with ``error`` set and
+    ``result()`` re-raises it instead of hanging waiters forever.
+    """
+
+    __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens",
+                 "submit_t", "first_token_t", "finish_t", "error",
+                 "_done")
+
+    def __init__(self, rid, prompt, max_new, eos_id):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.tokens = []
+        self.submit_t = time.perf_counter()
+        self.first_token_t = None
+        self.finish_t = None
+        self.error = None
+        self._done = threading.Event()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout)
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.rid} not finished")
+        if self.error is not None:
+            raise RuntimeError(
+                f"request {self.rid} failed: engine aborted") \
+                from self.error
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    @property
+    def ttft(self):
+        """Submit -> first generated token, seconds (None until then)."""
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def e2e(self):
+        """Submit -> finished, seconds (None until finished)."""
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.submit_t
+
+
+class ServingEngine:
+    """Slot-scheduled continuous-batching front-end over the batched
+    decode kernels.
+
+    params   name->array dict with the Program's parameter names (e.g.
+             ``transformer.extract_params()``); cast once to
+             ``compute_dtype`` (default: the dtype the block/lm_head
+             matmul weights imply — bf16-trained weights serve in bf16).
+    max_len  per-slot KV-cache capacity; every request needs
+             ``len(prompt) + max_new_tokens <= max_len``.
+    max_slots     concurrent sequences in the batched step.
+    decode_chunk  decode steps fused per device call.
+    min_bucket    smallest prefill bucket; prompts pad to the nearest
+             power-of-two multiple of it (compile-count bound).
+    eos_id   default EOS token id (per-request override in ``submit``).
+
+    Drive it synchronously (``generate_many`` / ``step`` +
+    ``results``) or from a background thread (``start``/``stop``) with
+    producers calling ``submit`` concurrently.
+    """
+
+    def __init__(self, params, n_layer, n_head, d_model, max_len=128,
+                 max_slots=8, decode_chunk=4, min_bucket=8, eos_id=None,
+                 compute_dtype=None, eps=1e-5, donate=True,
+                 registry=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.transformer import infer_compute_dtype
+
+        if d_model % n_head:
+            raise ValueError(f"d_model {d_model} % n_head {n_head} != 0")
+        if max_slots < 1 or decode_chunk < 1 or min_bucket < 1:
+            raise ValueError("max_slots, decode_chunk and min_bucket "
+                             "must all be >= 1")
+        self.n_layer, self.n_head, self.d_model = n_layer, n_head, d_model
+        self.max_len, self.max_slots = int(max_len), int(max_slots)
+        self.decode_chunk = int(decode_chunk)
+        self.min_bucket = int(min_bucket)
+        self.eos_id = eos_id
+        self._eps = eps
+        self._donate = donate
+        if compute_dtype is None:
+            compute_dtype = infer_compute_dtype(params)
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        table_len = np.asarray(params["pos_emb.w.w"]).shape[0]
+        if self.max_len > table_len:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the trained position-"
+                f"embedding table ({table_len} positions)")
+        self._p = jax.device_put(
+            {k: jnp.asarray(v, self.compute_dtype)
+             for k, v in params.items()})
+        dh = d_model // n_head
+        self._ck = tuple(
+            jnp.zeros((self.max_slots, self.max_len, n_head, dh),
+                      self.compute_dtype) for _ in range(n_layer))
+        self._cv = tuple(
+            jnp.zeros((self.max_slots, self.max_len, n_head, dh),
+                      self.compute_dtype) for _ in range(n_layer))
+        self._last = jnp.zeros((self.max_slots,), jnp.int32)
+        self._pos = jnp.zeros((self.max_slots,), jnp.int32)
+
+        self._slots = [None] * self.max_slots     # Request or None
+        self._free = list(range(self.max_slots))  # LIFO free list
+        self._queue = collections.deque()
+        self._completed = collections.deque()
+        self._qlock = threading.Lock()    # queue/completed/counters
+        self._dlock = threading.RLock()   # the device state (one driver)
+        self._next_rid = 0
+        self._prefill_fns = {}            # bucket -> compiled callable
+        self._decode_fn = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._error = None                # fatal error: engine is dead
+        self._inflight = 0                # popped from queue, not yet
+                                          # slotted (visible to idle)
+
+        self._reg = registry or _obs.get_registry()
+        self._reg.gauge("serving.slots_total").set(self.max_slots)
+        self._reg.gauge("serving.slots_active").set(0)
+        self._reg.gauge("serving.queue_depth").set(0)
+
+    # -- request intake ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, eos_id=None):
+        """Queue one request; returns its ``Request`` handle.  Thread-safe
+        (producers may submit while the engine decodes)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p_len = prompt.shape[0]
+        if p_len < 1:
+            raise ValueError("empty prompt")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1: {max_new}")
+        if p_len + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({p_len}) + max_new_tokens ({max_new}) exceeds "
+                f"the slot KV capacity max_len={self.max_len}")
+        with self._qlock:
+            # _error is set under _qlock in _abort, so checking it here
+            # closes the submit-after-abort window (a request appended
+            # after the abort drained the queue would hang forever)
+            if self._error is not None:
+                raise RuntimeError(
+                    "serving engine aborted") from self._error
+            rid = self._next_rid
+            self._next_rid += 1
+            req = Request(rid, prompt,  max_new,
+                          self.eos_id if eos_id is None else eos_id)
+            self._queue.append(req)
+            self._reg.gauge("serving.queue_depth").set(len(self._queue))
+        return req
+
+    def results(self, block=False, timeout=None):
+        """Drain finished requests (FIFO completion order; aborted
+        requests surface here too, with ``error`` set).  With
+        ``block=True``, waits up to ``timeout`` seconds for at least one
+        (``timeout=0`` = poll once; ``None`` = wait indefinitely)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            with self._qlock:
+                out = list(self._completed)
+                self._completed.clear()
+            if out or not block:
+                return out
+            if deadline is not None and time.monotonic() >= deadline:
+                return []
+            time.sleep(0.001)
+
+    # -- scheduler --------------------------------------------------------
+    @property
+    def active_slots(self):
+        return self.max_slots - len(self._free)
+
+    @property
+    def idle(self):
+        with self._qlock:
+            pending = bool(self._queue) or self._inflight > 0
+        return not pending and self.active_slots == 0
+
+    def step(self):
+        """One scheduler iteration: admit queued requests into free slots
+        (bucketed prefill), then run one batched decode chunk.  Returns
+        the number of requests finished this iteration.
+
+        A device error mid-step leaves the donated caches unusable, so
+        it is fatal: the engine aborts — every queued and in-flight
+        request completes with ``error`` set (waiters wake instead of
+        hanging) and further ``submit``/``step`` calls raise."""
+        if self._error is not None:
+            raise RuntimeError("serving engine aborted") from self._error
+        with self._dlock:
+            try:
+                finished = self._admit()
+                if self.active_slots:
+                    finished += self._decode()
+            except Exception as e:
+                self._abort(e)
+                raise
+        return finished
+
+    def _abort(self, exc):
+        """Fail every pending request and mark the engine dead."""
+        with self._qlock:
+            self._error = exc
+            self._inflight = 0
+            pending = list(self._queue)
+            self._queue.clear()
+            for s, req in enumerate(self._slots):
+                if req is not None:
+                    pending.append(req)
+                    self._slots[s] = None
+            self._free = list(range(self.max_slots))
+            for req in pending:
+                req.error = exc
+                req.finish_t = time.perf_counter()
+                self._completed.append(req)
+            self._reg.gauge("serving.queue_depth").set(0)
+            self._reg.gauge("serving.slots_active").set(0)
+            self._reg.counter("serving.aborted").inc(len(pending))
+        for req in pending:
+            req._done.set()
+
+    def run_until_idle(self):
+        """Drive ``step`` until the queue and every slot are empty."""
+        n = 0
+        while not self.idle:
+            n += self.step()
+        return n
+
+    def generate_many(self, prompts, max_new_tokens=16, eos_id=None):
+        """Synchronous batch front-end: submit every prompt, run to
+        completion, return one prompt+generated int32 array per prompt
+        (order preserved).  ``max_new_tokens`` may be a scalar or a
+        per-prompt sequence."""
+        if np.ndim(max_new_tokens) == 0:
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        if len(max_new_tokens) != len(prompts):
+            raise ValueError(
+                f"max_new_tokens has {len(max_new_tokens)} entries for "
+                f"{len(prompts)} prompts")
+        reqs = [self.submit(p, m, eos_id)
+                for p, m in zip(prompts, max_new_tokens)]
+        self.run_until_idle()
+        # drain OWN handles from the completion queue (a concurrent
+        # submit()+results() producer must still see its completions)
+        mine = {id(r) for r in reqs}
+        with self._qlock:
+            kept = [r for r in self._completed if id(r) not in mine]
+            self._completed.clear()
+            self._completed.extend(kept)
+        return [r.result(timeout=0) for r in reqs]
+
+    # -- background driver ------------------------------------------------
+    def start(self):
+        """Run the scheduler loop on a daemon thread until ``stop()``."""
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if self.idle:
+                    time.sleep(0.001)
+                    continue
+                try:
+                    self.step()
+                except Exception:
+                    return  # step() already aborted: waiters are woken
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="pt-serving-engine")
+        self._thread.start()
+
+    def stop(self, drain=True):
+        """Stop the background loop (``drain=True`` serves out queued and
+        active work first)."""
+        if self._thread is None:
+            return
+        if drain:
+            while not self.idle:
+                time.sleep(0.001)
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+
+    # -- internals --------------------------------------------------------
+    def bucket_for(self, p_len):
+        """Prefill bucket for a prompt length: the smallest power-of-two
+        multiple of ``min_bucket`` that covers it, capped at
+        ``max_len``."""
+        b = self.min_bucket
+        while b < p_len:
+            b *= 2
+        return min(b, self.max_len)
+
+    def _prefill_fn(self, bucket):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = _bd.make_prefill(self.n_layer, self.n_head, self.d_model,
+                                  bucket, self.max_len, eps=self._eps,
+                                  donate=self._donate)
+            self._prefill_fns[bucket] = fn
+            self._reg.counter(
+                "serving.prefill_compiles",
+                help="prefill executables built (one per shape bucket)",
+            ).inc()
+        return fn
+
+    def _decode(self):
+        if self._decode_fn is None:
+            self._decode_fn = _bd.make_decode_chunk(
+                self.n_layer, self.n_head, self.d_model,
+                self.decode_chunk, eps=self._eps, donate=self._donate)
+            self._reg.counter(
+                "serving.decode_compiles",
+                help="decode-chunk executables built (one per engine)",
+            ).inc()
+        t0 = time.perf_counter()
+        self._ck, self._cv, self._last, self._pos, toks = self._decode_fn(
+            self._p, self._ck, self._cv, self._last, self._pos)
+        toks = np.asarray(toks)  # host sync: [chunk, S]
+        wall = time.perf_counter() - t0
+        self._reg.histogram("serving.step_seconds").observe(
+            wall / self.decode_chunk)
+        emitted = 0
+        finished = 0
+        now = time.perf_counter()
+        for j in range(self.decode_chunk):
+            for s, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                tok = int(toks[j, s])
+                req.tokens.append(tok)
+                emitted += 1
+                if ((req.eos_id is not None and tok == req.eos_id)
+                        or len(req.tokens) >= req.max_new):
+                    self._slots[s] = None
+                    self._free.append(s)
+                    self._finish(req, now)
+                    finished += 1
+        self._reg.counter("serving.tokens").inc(emitted)
+        if wall > 0:
+            self._reg.gauge("serving.tok_s").set(emitted / wall)
+        self._reg.gauge("serving.slots_active").set(self.active_slots)
+        return finished
+
+    def _admit(self):
+        """Move queued requests into free slots (continuous batching:
+        runs between decode chunks).  Returns requests finished AT
+        prefill (immediate EOS / max_new == 1)."""
+        import jax.numpy as jnp
+
+        finished = 0
+        while self._free:
+            with self._qlock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                # in-flight until slotted/finished, so idle never reads
+                # True while an admission is mid-prefill
+                self._inflight += 1
+                self._reg.gauge("serving.queue_depth").set(
+                    len(self._queue))
+            try:
+                slot = self._free.pop()
+                p_len = req.prompt.shape[0]
+                bucket = self.bucket_for(p_len)
+                fn = self._prefill_fn(bucket)
+                padded = np.zeros(bucket, np.int32)
+                padded[:p_len] = req.prompt
+                with self._reg.histogram(
+                        "serving.prefill_seconds").time():
+                    (self._ck, self._cv, self._last, self._pos,
+                     first) = fn(self._p, self._ck, self._cv, self._last,
+                                 self._pos, np.int32(slot),
+                                 jnp.asarray(padded), np.int32(p_len))
+                    first = int(np.asarray(first))  # host sync
+                now = time.perf_counter()
+                req.first_token_t = now
+                req.tokens.append(first)
+                self._reg.counter("serving.admitted").inc()
+                self._reg.counter("serving.tokens").inc()
+                self._reg.histogram("serving.ttft_seconds").observe(
+                    now - req.submit_t)
+                if ((req.eos_id is not None and first == req.eos_id)
+                        or req.max_new == 1):
+                    self._free.append(slot)
+                    self._finish(req, now)
+                    finished += 1
+                else:
+                    self._slots[slot] = req
+                with self._qlock:
+                    self._inflight -= 1
+            except Exception:
+                # put the victim back where _abort (called by step) can
+                # see and fail it with everything else
+                with self._qlock:
+                    self._queue.appendleft(req)
+                    self._inflight -= 1
+                raise
+        self._reg.gauge("serving.slots_active").set(self.active_slots)
+        return finished
+
+    def _finish(self, req, now):
+        req.finish_t = now
+        self._reg.counter("serving.completed").inc()
+        self._reg.histogram("serving.e2e_seconds").observe(req.e2e)
+        with self._qlock:
+            self._completed.append(req)
+        req._done.set()
+
+    def stats(self):
+        """Snapshot of the engine's ``serving.*`` metrics."""
+        return self._reg.snapshot(prefix="serving.")

@@ -95,7 +95,8 @@ class Trainer:
 
     def train(self, reader, num_passes=1, event_handler=None,
               checkpoint_dir=None, checkpoint_every_n_passes=1,
-              async_checkpoint=False, prefetch=0, steps_per_call=1):
+              async_checkpoint=False, prefetch=0, steps_per_call=1,
+              fused_group=8, probe_samples=6):
         """``async_checkpoint=True`` writes per-pass checkpoints from a
         background thread (io.AsyncCheckpointer): training only pays the
         device->host snapshot, not serialization + disk IO.  Pending
@@ -113,9 +114,11 @@ class Trainer:
         fire once per batch with that batch's cost — BeginIteration
         before the group executes, EndIteration after, so a fused group
         interleaves as Begin..Begin End..End.  ``"auto"`` times the
-        first post-compile batches and switches to N=8 when the step is
-        dispatch-bound: it times a few single steps and one fused group
-        (both post-compile) and keeps whichever is faster per batch —
+        first post-compile batches and switches to ``fused_group`` when
+        the step is dispatch-bound: it times ``probe_samples`` single
+        steps and ``probe_samples - 1`` fused groups (both post-compile,
+        compared by median so one noisy window through a jittery host
+        link decides nothing) and keeps whichever is faster per batch —
         self-calibrating, so it also fuses when a slow host link (not
         the device) is the bottleneck.  Batches whose padded shapes
         differ run unfused (shape buckets compile separately anyway);
@@ -132,7 +135,8 @@ class Trainer:
             return self._train_fused(reader, num_passes, event_handler,
                                      checkpoint_dir,
                                      checkpoint_every_n_passes,
-                                     async_checkpoint, steps_per_call)
+                                     async_checkpoint, steps_per_call,
+                                     fused_group, probe_samples)
         if prefetch:
             from .reader import prefetch_to_device
 
@@ -227,7 +231,7 @@ class Trainer:
 
     def _train_fused(self, reader, num_passes, event_handler, checkpoint_dir,
                      checkpoint_every_n_passes, async_checkpoint,
-                     steps_per_call):
+                     steps_per_call, fused_group=8, probe_samples=6):
         """The steps_per_call train loop: group same-shape converted
         batches, stack them [steps, ...], one run_steps per group, unpack
         stacked fetches back to per-batch events."""
@@ -236,6 +240,12 @@ class Trainer:
         group_n = 1 if auto else int(steps_per_call)
         if not auto and group_n < 1:
             raise ValueError(f"steps_per_call must be >= 1: {group_n}")
+        fused_group = int(fused_group)
+        if auto and fused_group < 2:
+            raise ValueError(
+                f"fused_group must be >= 2 (a group of 1 is the unfused "
+                f"schedule): {fused_group}")
+        probe_samples = max(3, int(probe_samples))
         ckpt = _io.AsyncCheckpointer() if (
             checkpoint_dir and async_checkpoint) else None
         # auto-probe state, shared across passes: single-step timings,
@@ -289,14 +299,14 @@ class Trainer:
                             if auto:
                                 fused_t.append(
                                     (time.perf_counter() - t0) / len(run))
-                                if len(fused_t) >= 3:
+                                if len(fused_t) >= probe_samples - 1:
                                     # compare post-compile MEDIANS (a
                                     # single sample through a jittery
                                     # host link decides nothing): keep
                                     # the faster schedule from here on
                                     if float(np.median(fused_t[1:])) < \
                                             float(np.median(single_t[1:])):
-                                        group_n = 8
+                                        group_n = fused_group
                                     else:
                                         group_n = 1
                                     auto = False
@@ -311,7 +321,7 @@ class Trainer:
 
                 for item in reader():
                     feed = self.feeder.feed(item)
-                    if auto and len(single_t) < 4:
+                    if auto and len(single_t) < probe_samples:
                         # probe phase 1: single steps (first is a compile)
                         event_handler(BeginIteration(pass_id, batch_id))
                         t0 = time.perf_counter()
@@ -321,8 +331,9 @@ class Trainer:
                         emit_end(batch_id, vals,
                                  self._step_telemetry(single_t[-1], feed))
                         batch_id += 1
-                        if len(single_t) >= 4:
-                            group_n = 8  # probe phase 2: fused groups
+                        if len(single_t) >= probe_samples:
+                            # probe phase 2: fused groups
+                            group_n = fused_group
                         continue
                     sig = tuple(sorted(
                         (k, v.shape, str(getattr(v, "dtype", "")))

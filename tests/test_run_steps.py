@@ -140,7 +140,9 @@ def test_trainer_steps_per_call_auto_is_equivalent():
     from paddle_tpu.models import lenet
 
     rng = np.random.default_rng(3)
-    n_batches = 30  # enough to cover probe (4 single + 3 fused groups)
+    # enough to cover the probe (probe_samples=4 singles + 3 fused groups
+    # of fused_group=6) AND some post-commit batches either way
+    n_batches = 30
     imgs = rng.normal(size=(n_batches, 8, 1, 28, 28)).astype(np.float32)
     lbls = rng.integers(0, 10, (n_batches, 8, 1)).astype(np.int64)
 
@@ -158,6 +160,7 @@ def test_trainer_steps_per_call_auto_is_equivalent():
         trainer.init_params()
         ends = []
         trainer.train(reader, num_passes=1, steps_per_call=steps_per_call,
+                      fused_group=6, probe_samples=4,
                       event_handler=lambda e: ends.append(e) if isinstance(
                           e, pt.trainer.EndIteration) else None)
         assert [e.batch_id for e in ends] == list(range(n_batches))

@@ -1,0 +1,239 @@
+"""Continuous-batching serving engine (paddle_tpu/serving/) — slot
+lifecycle, EOS eviction, bucketed-prefill compile bound, token-identity
+vs the single-stream decode, and serving.* metrics exposure.  All on the
+CPU mesh (conftest), tiny model shapes."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import transformer
+from paddle_tpu.observability import metrics as _obs
+from paddle_tpu.serving import ServingEngine
+
+
+def _make_params(vocab=50, n_layer=2, n_head=2, d_model=32, max_len=32,
+                 dtype="float32", seed=7):
+    """Randomly initialized flagship weights (serving doesn't need a
+    trained model: greedy chains over random weights are deterministic)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        transformer.build(vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+                          d_model=d_model, max_len=max_len,
+                          dropout_rate=0.0, dtype=dtype)
+    exe = pt.Executor()
+    exe.run(startup)
+    return transformer.extract_params(program=main)
+
+
+VOCAB, NL, NH, DM, T = 50, 2, 2, 32, 32
+
+
+@pytest.fixture
+def params():
+    return _make_params(VOCAB, NL, NH, DM, T)
+
+
+@pytest.fixture(autouse=True)
+def fresh_serving_metrics():
+    _obs.get_registry().clear(prefix="serving.")
+    yield
+
+
+def _engine(params, **kw):
+    kw.setdefault("max_len", T)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("min_bucket", 4)
+    return ServingEngine(params, NL, NH, DM, **kw)
+
+
+def test_slot_admit_free_lifecycle(params):
+    """More requests than slots: all admitted (continuous batching waves),
+    every slot freed at the end, queue drained, counters consistent."""
+    eng = _engine(params, max_slots=2)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, VOCAB, (l,)) for l in (3, 5, 2, 4, 6)]
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    assert eng.stats()["serving.queue_depth"] == 5
+    eng.run_until_idle()
+    assert all(r.done for r in reqs)
+    assert eng.active_slots == 0 and eng.idle
+    st = eng.stats()
+    assert st["serving.queue_depth"] == 0
+    assert st["serving.slots_active"] == 0
+    assert st["serving.admitted"] == 5
+    assert st["serving.completed"] == 5
+    # every request got exactly its token budget (no EOS configured)
+    for r, p in zip(reqs, prompts):
+        out = r.result(timeout=0)
+        assert out.shape == (len(p) + 6,)
+        np.testing.assert_array_equal(out[: len(p)], p)
+    # finished handles surface through results() exactly once
+    done = eng.results()
+    assert {r.rid for r in done} == {r.rid for r in reqs}
+    assert eng.results() == []
+
+
+def test_eos_evicts_slot_early(params):
+    """A request whose greedy chain hits EOS frees its slot early and its
+    output stops AT the EOS token."""
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(1, VOCAB, (4,))
+    # learn the chain once without EOS, then re-serve with eos_id set to
+    # a token the chain is known to emit
+    eng = _engine(params)
+    full = eng.generate_many([prompt], max_new_tokens=12)[0]
+    gen = full[4:]
+    eos = int(gen[len(gen) // 2])  # a mid-stream token
+    cut = list(gen).index(eos)
+
+    _obs.get_registry().clear(prefix="serving.")  # counters are global
+    eng2 = _engine(params)
+    out = eng2.generate_many([prompt], max_new_tokens=12, eos_id=eos)[0]
+    np.testing.assert_array_equal(out, full[: 4 + cut + 1])
+    assert out[-1] == eos
+    assert eng2.active_slots == 0
+    # fewer decode tokens than the no-EOS run (the slot really left)
+    assert eng2.stats()["serving.completed"] == 1
+
+
+def test_bucketed_prefill_bounds_compiles(params):
+    """50+ mixed-length requests: executables == used prefill buckets + 1
+    decode chunk, regardless of request count."""
+    eng = _engine(params, max_slots=8, min_bucket=4)
+    rng = np.random.default_rng(2)
+    n = 52
+    lens = rng.integers(1, 14, n)  # buckets {4, 8, 16}
+    prompts = [rng.integers(1, VOCAB, (int(l),)) for l in lens]
+    outs = eng.generate_many(prompts, max_new_tokens=4)
+    assert len(outs) == n
+    buckets = {eng.bucket_for(int(l)) for l in lens}
+    st = eng.stats()
+    assert st["serving.prefill_compiles"] == len(buckets) <= 3
+    assert st["serving.decode_compiles"] == 1
+    assert st["serving.admitted"] == n
+    assert st["serving.completed"] == n
+    # the counters must reflect REAL jit-cache entries: one executable
+    # per bucket callable / per decode chunk, no silent retraces
+    assert eng._decode_fn._cache_size() == 1
+    assert sorted(eng._prefill_fns) == sorted(buckets)
+    assert all(f._cache_size() == 1 for f in eng._prefill_fns.values())
+
+
+def test_batched_decode_token_identical_to_single_stream(params):
+    """The acceptance bar: any request served through the batched engine
+    produces exactly the tokens of running it ALONE through
+    transformer.generate (greedy, same weights) — mixed lengths, slot
+    reuse, mid-stream admissions and all."""
+    eng = _engine(params, max_slots=3, decode_chunk=5)
+    rng = np.random.default_rng(3)
+    specs = [(3, 8), (7, 12), (1, 20), (9, 5), (4, 16), (12, 9), (2, 11)]
+    prompts = [rng.integers(1, VOCAB, (pl,)) for pl, _ in specs]
+    max_new = [mn for _, mn in specs]
+    outs = eng.generate_many(prompts, max_new)
+    for p, m, o in zip(prompts, max_new, outs):
+        ref, _ = transformer.generate(params, p[None], max_len=T,
+                                      n_layer=NL, n_head=NH, d_model=DM,
+                                      return_logits=False)
+        np.testing.assert_array_equal(o, np.asarray(ref)[0][: len(p) + m])
+
+
+def test_bf16_weights_serve_in_bf16_and_match(params):
+    """bf16 block weights: the engine infers bf16 compute (cache
+    discipline) and still matches the single-stream bf16 decode."""
+    import jax.numpy as jnp
+
+    p16 = {k: (jnp.asarray(v, jnp.bfloat16)
+               if (k.startswith("block") or k.startswith("lm_head"))
+               and k.endswith(".w") else v)
+           for k, v in params.items()}
+    eng = _engine(p16)
+    assert eng.compute_dtype == jnp.bfloat16
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, VOCAB, (l,)) for l in (3, 6)]
+    outs = eng.generate_many(prompts, max_new_tokens=8)
+    for p, o in zip(prompts, outs):
+        ref, _ = transformer.generate(p16, p[None], max_len=T, n_layer=NL,
+                                      n_head=NH, d_model=DM,
+                                      return_logits=False)
+        np.testing.assert_array_equal(o, np.asarray(ref)[0][: len(p) + 8])
+
+
+def test_serving_metrics_exposed(params):
+    """The telemetry contract: TTFT/e2e histograms count one observation
+    per request, token counter matches emitted tokens, and everything
+    reaches the Prometheus exposition."""
+    eng = _engine(params)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, VOCAB, (l,)) for l in (2, 5, 3)]
+    reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["serving.ttft_seconds"]["count"] == 3
+    assert st["serving.e2e_seconds"]["count"] == 3
+    assert st["serving.tokens"] >= 3 * 5  # budget + discarded mid-chunk
+    assert st["serving.step_seconds"]["count"] >= 1
+    assert st["serving.prefill_seconds"]["count"] == 3
+    assert st["serving.slots_total"] == 4
+    for r in reqs:
+        assert r.ttft is not None and r.e2e is not None
+        assert 0 <= r.ttft <= r.e2e
+    text = _obs.get_registry().to_text()
+    for frag in ("serving_ttft_seconds", "serving_tok_s",
+                 "serving_queue_depth", "serving_admitted"):
+        assert frag in text, frag
+
+
+def test_submit_validation(params):
+    eng = _engine(params)
+    with pytest.raises(ValueError):
+        eng.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], max_new_tokens=0)
+    with pytest.raises(ValueError):  # p_len + max_new > max_len
+        eng.submit(np.ones(20, np.int32), max_new_tokens=T)
+
+
+def test_engine_abort_fails_pending_requests(params):
+    """A device error mid-serve is fatal (donated caches are gone): the
+    engine aborts, waiters wake with ``error`` set instead of hanging,
+    and further submits raise."""
+    eng = _engine(params)
+
+    def boom():
+        raise RuntimeError("device gone")
+
+    eng._admit = boom
+    eng.start()
+    try:
+        req = eng.submit(np.asarray([1, 2, 3]), max_new_tokens=4)
+        assert req.wait(timeout=60), "abort did not wake the waiter"
+        assert req.error is not None
+        with pytest.raises(RuntimeError):
+            req.result(timeout=0)
+        with pytest.raises(RuntimeError):
+            eng.submit([1], max_new_tokens=1)
+        (failed,) = eng.results()
+        assert failed is req
+        assert eng.stats()["serving.aborted"] == 1
+    finally:
+        eng.stop()
+
+
+def test_background_thread_driver(params):
+    """start()/stop() + concurrent submit: the Poisson-load path the
+    serving benchmark uses."""
+    eng = _engine(params, max_slots=2)
+    eng.start()
+    try:
+        rng = np.random.default_rng(6)
+        reqs = [eng.submit(rng.integers(1, VOCAB, (3,)), max_new_tokens=6)
+                for _ in range(5)]
+        for r in reqs:
+            assert r.wait(timeout=60), "request did not finish"
+        done = eng.results()
+        assert {r.rid for r in done} == {r.rid for r in reqs}
+    finally:
+        eng.stop()
+    assert eng.idle

@@ -207,6 +207,24 @@ def test_feeder_dense_fallback_sequence():
     assert feed["x@LENGTH"].tolist() == [2, 1]
 
 
+def test_feeder_dense_fallback_empty_first_sequence():
+    """Regression (ADVICE round 5): detection sniffed only col[0], so a
+    batch whose FIRST cell is an empty sparse sequence skipped the
+    SparseRow densification and crashed in the lod padding path.  Empty
+    sequences must densify to [0, dim] rows."""
+    prog = pt.Program()
+    with pt.program_guard(prog, pt.Program()):
+        var = layers.data("x", shape=[6], lod_level=1)
+    feed = pt.DataFeeder([var], pad_multiple=2).feed([
+        ([],),                                             # empty first
+        ([p.SparseRow([1], None, 6), p.SparseRow([2, 4], None, 6)],),
+    ])
+    assert feed["x"].shape == (2, 2, 6)
+    assert feed["x"][0].tolist() == [[0] * 6, [0] * 6]
+    assert feed["x"][1, 1].tolist() == [0, 0, 1, 0, 1, 0]
+    assert feed["x@LENGTH"].tolist() == [0, 2]
+
+
 def test_v1_data_layer_sparse_and_sequence():
     """data_layer(sparse=True) -> native sparse handle; with seq_len it
     must declare lod_level=1 so sequence rows feed correctly."""

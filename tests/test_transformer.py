@@ -116,6 +116,29 @@ def test_generate_matches_program_forward():
     np.testing.assert_array_equal(np.asarray(gen_tokens), toks)
 
 
+def test_infer_compute_dtype_ignores_stray_adapters():
+    """Regression (ADVICE round 5): the serving-dtype scan is restricted
+    to block/lm_head matmul weights — a stray low-precision matrix (an
+    f16 adapter bolted onto the dict) must not silently downgrade the
+    whole decode, and the f32 embedding tables must not promote it."""
+    import jax.numpy as jnp
+
+    base = {
+        "tok_emb.w": np.zeros((8, 4), np.float32),
+        "pos_emb.w.w": np.zeros((8, 4), np.float32),
+        "block0_att_q.w": jnp.zeros((4, 4), jnp.bfloat16),
+        "lm_head.w": jnp.zeros((4, 8), jnp.bfloat16),
+    }
+    assert transformer.infer_compute_dtype(base) == jnp.bfloat16
+    # stray f16 adapter outside the block/head namespace: ignored
+    with_adapter = dict(base, **{
+        "adapter0.w": jnp.zeros((4, 4), jnp.float16)})
+    assert transformer.infer_compute_dtype(with_adapter) == jnp.bfloat16
+    # no block/head names at all: fall back to any >=2-D floating weight
+    assert transformer.infer_compute_dtype(
+        {"tok_emb.w": np.zeros((8, 4), np.float32)}) == jnp.float32
+
+
 def test_generate_greedy_continuation():
     """After training next-token = (tok+1) mod vocab, greedy decode
     continues the pattern from a short prompt."""

@@ -28,4 +28,29 @@ if ! python -m paddle_tpu --metrics-selftest > /tmp/_t1_selftest.log 2>&1; then
     cat /tmp/_t1_selftest.log >&2
     exit 1
 fi
+# serving smoke: the continuous-batching engine must beat the sequential
+# single-stream baseline (asserted inside --smoke) and print ONE
+# parseable JSON row with the throughput/latency/compile fields
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python benchmarks/serving.py --smoke \
+        > /tmp/_t1_serving.json 2> /tmp/_t1_serving.log; then
+    echo "TIER1 REGRESSION: serving smoke failed" >&2
+    cat /tmp/_t1_serving.log >&2
+    cat /tmp/_t1_serving.json >&2
+    exit 1
+fi
+if ! python -c "
+import json, sys
+rows = [json.loads(l) for l in open('/tmp/_t1_serving.json') if l.strip()]
+assert len(rows) == 1, f'expected ONE json line, got {len(rows)}'
+row = rows[0]
+for k in ('tok_s', 'baseline_tok_s', 'speedup', 'ttft_p50_ms',
+          'e2e_p99_ms', 'prefill_compiles', 'decode_compiles'):
+    assert k in row, f'missing field {k}: {row}'
+print('serving smoke:', json.dumps(row))
+"; then
+    echo "TIER1 REGRESSION: serving smoke emitted invalid JSON" >&2
+    cat /tmp/_t1_serving.json >&2
+    exit 1
+fi
 exit $rc
