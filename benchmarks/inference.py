@@ -16,6 +16,12 @@ Three rows, printed as JSON lines:
    the output); on a co-located host the device time is the floor.
 
 Usage: python benchmarks/inference.py [--rows resnet,gpt,capi]
+
+These rows also ride along in the driver-captured BENCH json:
+``BENCH_INFER=1 python bench.py`` folds them into the flagship line's
+``extra`` (``bench.infer_rows``), each row isolated so a failure lands
+as an ``"infer_<row>": "FAILED: ..."`` string instead of killing the
+round's numbers.
 """
 
 import argparse

@@ -25,6 +25,7 @@ fetch list, available state) — the analog of the reference caching nothing
 and paying interpreter overhead per op per step.
 """
 
+import os
 import time
 
 import jax
@@ -43,15 +44,19 @@ def _remat_segment(seg_fn, env, param_names=()):
     recompute is made DATA-DEPENDENT on the incoming cotangents via
     ``optimization_barrier``.
 
-    Plain ``jax.checkpoint`` on a flat (unrolled) layer stack lets XLA's
+    This is the FALLBACK path for non-uniform segments.  Plain
+    ``jax.checkpoint`` on a flat (unrolled) layer stack lets XLA's
     scheduler hoist every segment's rematted forward to the start of the
     backward — all layers' recomputed activations end up live at once and
     remat saves nothing (measured: GPT t=16k bs8 sat at 22.6 GB with the
     OOM dump showing 10+ rematted 768 MB FFN tiles alive together).
-    ``lax.scan`` over layers is the canonical fix, but a Program is an
-    unrolled op list; the barrier gives the same serialization — segment
-    k's recompute cannot start until segment k+1's backward has produced
-    k's output cotangents."""
+    ``lax.scan`` over layers is the canonical fix — the scan-remat engine
+    (``_run_fwd``'s ``_try_scan_group``) runs structurally repeated
+    segments exactly that way, with weights stacked along the scan axis —
+    but a Program's non-repeating segments (prologue/epilogue, irregular
+    nets) still need serialization; the barrier gives it — segment k's
+    recompute cannot start until segment k+1's backward has produced k's
+    output cotangents."""
 
     def _inexact(x):
         try:
@@ -101,6 +106,43 @@ def _remat_segment(seg_fn, env, param_names=()):
 
     run.defvjp(run_fwd, run_bwd)
     return run(env)
+
+
+def _scan_groups_for(program, segments):
+    """Uniform (scan-able) groups among the program's remat segments,
+    cached on the program keyed by (version, segment list).  Only groups
+    whose period contains at least one WRAPPED segment qualify — the scan
+    engine exists to give remat O(1)-per-layer temps; pure saved runs gain
+    nothing from restructuring.  ``PADDLE_TPU_SCAN_REMAT=0`` disables the
+    engine entirely (every wrapped segment falls back to the barrier)."""
+    if os.environ.get("PADDLE_TPU_SCAN_REMAT", "1").lower() in (
+            "0", "", "false"):
+        return []
+    key = (program._version, tuple(tuple(s) for s in segments))
+    cached = getattr(program, "_scan_group_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    from .ir import find_uniform_groups
+
+    groups = []
+    for g in find_uniform_groups(program, segments):
+        period = segments[g["start"]:g["start"] + g["period"]]
+        if any((s[2] if len(s) > 2 else True) for s in period):
+            groups.append(g)
+    program._scan_group_cache = (key, groups)
+    return groups
+
+
+def _rng_op_count(ops):
+    """Stateful random-op instances in an op run — each draws one key from
+    the LoweringCtx counter, so the scan body must advance the counter by
+    this much per iteration to reproduce the unrolled key stream."""
+    n = 0
+    for op in ops:
+        impl = get_op_impl(op.type)
+        if impl.stateful_rng and "_key" not in op.attrs:
+            n += 1
+    return n
 
 
 class LoweringCtx:
@@ -595,7 +637,207 @@ class Executor:
                         needed_after.reverse()  # needed_after[i] = used
                         # by ops[i:] (+loss/aux); index bw == just aux
 
-                        for seg in segments:
+                        def _try_scan_group(group):
+                            """Run ``segments[i0 : i0 + P*G]`` — G
+                            structurally identical periods of P segments
+                            (one transformer layer each) — as ONE
+                            ``lax.scan``: per-layer weights stack along the
+                            scan axis (xs), the residual stream threads as
+                            the carry, and wrapped sub-segments run under
+                            plain ``jax.checkpoint`` INSIDE the scan body.
+                            The scan structurally serializes backward
+                            recompute (segment k's remat cannot start until
+                            its iteration's cotangent arrives), so remat
+                            temps are O(1) per layer — the compilable HLO
+                            the barrier spelling could not guarantee at
+                            t=16k.  Returns False (caller falls back to the
+                            per-segment barrier path) when the group cannot
+                            be classified into carry/xs/shared inputs or
+                            the scan fails to trace."""
+                            i0, P, G = (group["start"], group["period"],
+                                        group["count"])
+                            ext_maps = group["ext_maps"]
+                            out_maps = group["out_maps"]
+                            c0 = fctx._op_counter
+                            reg = _obs.get_registry()
+                            try:
+                                out0 = list(out_maps[0].keys())
+                                out_sets = [set(m.values()) for m in out_maps]
+                                written_any = set().union(*out_sets)
+                                pre_w = [set()]
+                                for k in range(G):
+                                    pre_w.append(pre_w[-1] | out_sets[k])
+
+                                # classify each canonical external input:
+                                # carry (produced by the previous period),
+                                # shared (same name+value every period), or
+                                # xs (per-period values stacked on the scan
+                                # axis — the per-layer weights)
+                                carry_map, shared_names, xs_names = {}, [], []
+                                for n in ext_maps[0]:
+                                    vals = [ext_maps[k][n] for k in range(G)]
+                                    m = vals[1] if G > 1 else None
+                                    if (m in out_maps[0] and n in e and all(
+                                            ext_maps[k][n]
+                                            == out_maps[k - 1][m]
+                                            for k in range(1, G))):
+                                        carry_map[n] = m
+                                    elif (all(v == n for v in vals)
+                                          and n not in written_any
+                                          and n in e):
+                                        shared_names.append(n)
+                                    elif all(vals[k] in e
+                                             and vals[k] not in pre_w[k]
+                                             for k in range(G)):
+                                        xs_names.append(n)
+                                    else:
+                                        raise ValueError(
+                                            f"unclassifiable input {n!r}")
+
+                                # outputs escaping the group: final-period
+                                # values come from the carry; anything else
+                                # consumed after the group stacks as ys
+                                t_end = segments[i0 + P * G - 1][1]
+                                names_after = needed_after[t_end]
+                                carry_vals = set(carry_map.values())
+                                inv_carry = {m: n
+                                             for n, m in carry_map.items()}
+                                ys_names = set()
+                                ys_writes = []   # (env_name, canonical, k)
+                                carry_writes = {}  # env_name -> carry input
+                                for m in out0:
+                                    for k in range(G):
+                                        on = out_maps[k][m]
+                                        if on not in names_after:
+                                            continue
+                                        if k == G - 1 and m in inv_carry:
+                                            carry_writes[on] = inv_carry[m]
+                                        else:
+                                            ys_names.add(m)
+                                            ys_writes.append((on, m, k))
+
+                                # per-sub-segment plan (canonical frame):
+                                # ops, wrap flag, outputs needed later in
+                                # the period, external uses, rng-op count
+                                sub = []
+                                for seg_ in segments[i0:i0 + P]:
+                                    s_, t_ = seg_[0], seg_[1]
+                                    wrap_ = (seg_[2] if len(seg_) > 2
+                                             else True)
+                                    sub.append([block.ops[s_:t_], wrap_])
+                                needed_sub = [set(ys_names) | carry_vals]
+                                for ops_j, _w in reversed(sub):
+                                    nxt = set(needed_sub[0])
+                                    for op_ in ops_j:
+                                        op_uses(op_, nxt, set())
+                                    needed_sub.insert(0, nxt)
+                                plan_subs = []
+                                nr = 0
+                                for j, (ops_j, wrap_) in enumerate(sub):
+                                    written_j = {
+                                        n for op_ in ops_j
+                                        for n in op_.output_names()}
+                                    out_j = tuple(sorted(
+                                        written_j & needed_sub[j + 1]))
+                                    uses_j = set()
+                                    for op_ in ops_j:
+                                        op_uses(op_, uses_j, set())
+                                    nr_j = _rng_op_count(ops_j)
+                                    plan_subs.append(
+                                        (ops_j, wrap_, out_j,
+                                         tuple(sorted(uses_j)), nr, nr_j))
+                                    nr += nr_j
+
+                                shared_env = {n: e[n] for n in shared_names}
+                                xs_stacked = {
+                                    n: jnp.stack(
+                                        [e[ext_maps[k][n]]
+                                         for k in range(G)])
+                                    for n in xs_names
+                                }
+                                carry0 = {n: e[n] for n in carry_map}
+
+                                def body(carry, xs):
+                                    k_idx, xvals = xs
+                                    e2 = dict(shared_env)
+                                    e2.update(carry)
+                                    e2.update(xvals)
+                                    base = (c0 + k_idx * nr) if nr else c0
+                                    for (ops_j, wrap_, out_j, uses_j,
+                                         off_j, _nr_j) in plan_subs:
+                                        cj = base + off_j if nr else c0
+                                        if not wrap_:
+                                            fctx._op_counter = cj
+                                            run_block_ops(
+                                                fctx, block, ops_j, e2,
+                                                inside_grad_prefix=True)
+                                            continue
+
+                                        def seg_fn(env_in, _ops=ops_j,
+                                                   _out=out_j, _c=cj):
+                                            fctx._op_counter = _c
+                                            e3 = dict(env_in)
+                                            run_block_ops(
+                                                fctx, block, _ops, e3,
+                                                inside_grad_prefix=True)
+                                            return {n: e3[n] for n in _out
+                                                    if n in e3}
+
+                                        env_sub = {u: e2[u] for u in uses_j
+                                                   if u in e2}
+                                        e2.update(
+                                            jax.checkpoint(seg_fn)(env_sub))
+                                    new_carry = {
+                                        n: e2[carry_map[n]]
+                                        for n in carry_map}
+                                    ys = {m: e2[m] for m in ys_names}
+                                    return new_carry, ys
+
+                                carry_f, ys = jax.lax.scan(
+                                    body,
+                                    carry0,
+                                    (jnp.arange(G, dtype=jnp.int32),
+                                     xs_stacked),
+                                    length=G)
+                                for on, m, k in sorted(ys_writes,
+                                                       key=lambda w: w[2]):
+                                    e[on] = ys[m][k]
+                                for on, n in carry_writes.items():
+                                    e[on] = carry_f[n]
+                                fctx._op_counter = c0 + G * nr
+                                reg.counter(
+                                    "executor.scan_remat_groups",
+                                    help="remat segment groups executed as "
+                                         "lax.scan over layers").inc()
+                                plan_log.append(
+                                    {"start": i0, "period": P, "count": G,
+                                     "carry": sorted(carry_map),
+                                     "xs": len(xs_names),
+                                     "shared": len(shared_names)})
+                                return True
+                            except Exception:
+                                # classification/trace failure: restore the
+                                # rng counter and run the group segment by
+                                # segment through the barrier fallback
+                                fctx._op_counter = c0
+                                reg.counter(
+                                    "executor.scan_remat_fallbacks",
+                                    help="segment groups that fell back to "
+                                         "the barrier spelling").inc()
+                                return False
+
+                        groups = _scan_groups_for(program, segments)
+                        by_start = {g["start"]: g for g in groups}
+                        plan_log = []
+                        self.last_remat_plan = plan_log
+                        si = 0
+                        while si < len(segments):
+                            g = by_start.get(si)
+                            if g is not None and _try_scan_group(g):
+                                si += g["period"] * g["count"]
+                                continue
+                            seg = segments[si]
+                            si += 1
                             s, t = seg[0], seg[1]
                             wrap = seg[2] if len(seg) > 2 else True
                             seg_ops = block.ops[s:t]

@@ -883,20 +883,28 @@ def sequence_softmax(x, name=None):
 
 
 def flash_attention_packed(q, k, v, n_head, causal=False, sm_scale=None,
-                           name=None):
+                           block_q=None, block_k=None, name=None):
     """Fused attention on the raw projection layout: q/k/v [b, t, h*d]
     (what the QKV matmuls emit) -> [b, t, h*d] (what the out-projection
     consumes).  No [b,t,h,d]<->[bh,t,d] pack/unpack transposes exist —
     heads are lane slices in the kernel's block index maps
-    (ops/pallas_attention.py).  Requires d_head % 128 == 0 or n_head 1."""
+    (ops/pallas_attention.py).  Requires d_head % 128 == 0, d_head == 64
+    with even n_head (two heads per lane slice), or n_head 1.
+    ``block_q``/``block_k`` override the kernel tile sizes (the MFU tuning
+    knob bench.py exposes as BENCH_GPT_BLOCK_Q/K)."""
     helper = LayerHelper("flash_attention_packed", name=name)
     out = helper.create_tmp_variable(q.dtype, q.shape)
+    attrs = {"n_head": int(n_head), "causal": bool(causal),
+             "sm_scale": 0.0 if sm_scale is None else float(sm_scale)}
+    if block_q:
+        attrs["block_q"] = int(block_q)
+    if block_k:
+        attrs["block_k"] = int(block_k)
     helper.append_op(
         type="flash_attention_packed",
         inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
         outputs={"Out": [out.name]},
-        attrs={"n_head": int(n_head), "causal": bool(causal),
-               "sm_scale": 0.0 if sm_scale is None else float(sm_scale)},
+        attrs=attrs,
     )
     return out
 
@@ -926,7 +934,8 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
 
 def multi_head_attention(queries, keys, values, d_model, n_head,
                          dropout_rate=0.0, causal=False, is_test=False,
-                         param_attr=None, name=None):
+                         param_attr=None, block_q=None, block_k=None,
+                         name=None):
     """Multi-head attention block: QKV projections -> fused flash
     attention (Pallas TPU kernel) -> output projection.
 
@@ -961,12 +970,17 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
            name=None if name is None else name + "_k")
     v = fc(values, d_model, num_flatten_dims=2, param_attr=_proj_attr("v"),
            name=None if name is None else name + "_v")
-    if dh % 128 == 0 or n_head == 1:
-        # lane-aligned head width: the packed kernel takes the projection
-        # outputs as-is and no head pack/unpack transposes exist (8% of
-        # flagship device time on the 4-D path — RESULTS.md round 4/5)
+    from ..ops.pallas_attention import packed_sub_heads
+
+    if packed_sub_heads(n_head, dh) is not None:
+        # packable head geometry (d_head % 128 == 0, d_head == 64 with
+        # even n_head — two heads per lane slice — or n_head == 1): the
+        # packed kernel takes the projection outputs as-is and no head
+        # pack/unpack transposes exist (8% of flagship device time on
+        # the 4-D path — RESULTS.md round 4/5)
         ctx = flash_attention_packed(q, k, v, n_head, causal=causal,
-                                     sm_scale=1.0 / float(dh) ** 0.5)
+                                     sm_scale=1.0 / float(dh) ** 0.5,
+                                     block_q=block_q, block_k=block_k)
     else:
         qh = reshape(q, [b, tq, n_head, dh])
         kh = reshape(k, [b, tk, n_head, dh])

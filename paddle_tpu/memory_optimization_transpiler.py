@@ -223,6 +223,33 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
                   f"{n_wrap} wrapped, expensive at {expensive_at}")
         return segments
 
+    # "full" policy: prefer cuts at the boundaries of the program's
+    # repeated structure (one transformer block per segment) — uniform
+    # segments are what the Executor's scan-remat engine can run as one
+    # lax.scan with stacked weights (O(1)-per-layer remat temps, the form
+    # that compiles at t=16k).  Liveness-minimal sqrt-N cuts remain the
+    # fallback for programs with no repetition.
+    from .core.ir import detect_repeated_run
+
+    rep = detect_repeated_run(program, 0, n_fwd)
+    if rep is not None and num_segments is None:
+        s0, p, count = rep
+        segments = []
+        if s0 > 0:
+            segments.append((0, s0, s0 >= min_segment))
+        segments += [(s0 + i * p, s0 + (i + 1) * p, True)
+                     for i in range(count)]
+        tail = s0 + count * p
+        if tail < n_fwd:
+            segments.append((tail, n_fwd, (n_fwd - tail) >= min_segment))
+        program._remat_segments = segments
+        program._bump_version()
+        if print_log:
+            print(f"memory_optimize[full]: {count} uniform segments of "
+                  f"{p} ops at {s0} (+prologue/epilogue), scan-remat "
+                  f"eligible")
+        return segments
+
     graph = ControlFlowGraph(program, 0, block.ops[:n_fwd])
     k = num_segments or max(2, int(math.isqrt(n_fwd)))
     # parameters/data cross every cut anyway — exclude them from cut cost

@@ -16,12 +16,13 @@ from ..layers import tensor as ltensor
 
 
 def transformer_block(x, d_model, n_head, d_ff, dropout_rate, is_test,
-                      name):
+                      name, attn_block_q=None, attn_block_k=None):
     """Pre-LN block: x + MHA(LN(x)) then x + FFN(LN(x))."""
     ln1 = layers.layer_norm(x, begin_norm_axis=2, name=name + "_ln1")
     att = layers.multi_head_attention(
         ln1, ln1, ln1, d_model=d_model, n_head=n_head,
         dropout_rate=dropout_rate, causal=True, is_test=is_test,
+        block_q=attn_block_q, block_k=attn_block_k,
         name=name + "_att")
     x = x + att
     ln2 = layers.layer_norm(x, begin_norm_axis=2, name=name + "_ln2")
@@ -35,9 +36,12 @@ def transformer_block(x, d_model, n_head, d_ff, dropout_rate, is_test,
 
 def gpt_trunk(tokens, vocab_size, n_layer=4, n_head=8, d_model=256,
               d_ff=None, max_len=128, dropout_rate=0.1, is_test=False,
-              dtype="bfloat16"):
+              dtype="bfloat16", attn_block_q=None, attn_block_k=None):
     """Causal LM trunk up to the final layer norm: [batch, time, d_model]
-    hidden states in ``dtype`` (the head is attached by the caller)."""
+    hidden states in ``dtype`` (the head is attached by the caller).
+    ``attn_block_q``/``attn_block_k`` tune the flash-attention kernel tile
+    sizes (smaller q tiles shrink the triangular diagonal band — see
+    ops/pallas_attention.py causal_flash_flops)."""
     d_ff = d_ff or 4 * d_model
     b, t = tokens.shape[0], tokens.shape[1]
     emb = layers.embedding(tokens, size=[vocab_size, d_model],
@@ -50,16 +54,20 @@ def gpt_trunk(tokens, vocab_size, n_layer=4, n_head=8, d_model=256,
         x = layers.dropout(x, dropout_rate, is_test=is_test)
     for i in range(n_layer):
         x = transformer_block(x, d_model, n_head, d_ff, dropout_rate,
-                              is_test, name=f"block{i}")
+                              is_test, name=f"block{i}",
+                              attn_block_q=attn_block_q,
+                              attn_block_k=attn_block_k)
     return layers.layer_norm(x, begin_norm_axis=2, name="ln_f")
 
 
 def gpt(tokens, vocab_size, n_layer=4, n_head=8, d_model=256, d_ff=None,
-        max_len=128, dropout_rate=0.1, is_test=False, dtype="bfloat16"):
+        max_len=128, dropout_rate=0.1, is_test=False, dtype="bfloat16",
+        attn_block_q=None, attn_block_k=None):
     """Causal LM trunk: returns [batch, time, vocab] logits (float32)."""
     x = gpt_trunk(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
                   d_model=d_model, d_ff=d_ff, max_len=max_len,
-                  dropout_rate=dropout_rate, is_test=is_test, dtype=dtype)
+                  dropout_rate=dropout_rate, is_test=is_test, dtype=dtype,
+                  attn_block_q=attn_block_q, attn_block_k=attn_block_k)
     logits = layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False,
                        name="lm_head")
     return ltensor.cast(logits, "float32")
@@ -251,7 +259,10 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
             ctx = jnp.einsum("bhT,bThd->bhd", a, cv).reshape(b, d_model)
             x = x + ctx @ w("att_out.w") + w("att_out.b")
             h2 = ln(x, w("ln2.scale"), w("ln2.bias"))
-            ff = jax.nn.gelu(h2 @ w("ffn1.w") + w("ffn1.b"))
+            # approximate=False matches the training program's gelu op
+            # (exact erf form — see ops/activation_ops.py)
+            ff = jax.nn.gelu(h2 @ w("ffn1.w") + w("ffn1.b"),
+                             approximate=False)
             x = x + ff @ w("ffn2.w") + w("ffn2.b")
         x = ln(x, p["ln_f.scale"], p["ln_f.bias"])
         logits = jnp.matmul(x, p["lm_head.w"],
@@ -297,7 +308,8 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
 
 def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
           max_len=128, dropout_rate=0.1, is_test=False,
-          learning_rate=1e-3, dtype="bfloat16", fused_head=False):
+          learning_rate=1e-3, dtype="bfloat16", fused_head=False,
+          attn_block_q=None, attn_block_k=None):
     """Next-token-prediction training program.
 
     Feeds: tokens [batch, max_len] int64, labels [batch, max_len] int64
@@ -323,7 +335,8 @@ def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
         x = gpt_trunk(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
                       d_model=d_model, d_ff=d_ff, max_len=max_len,
                       dropout_rate=dropout_rate, is_test=is_test,
-                      dtype=dtype)
+                      dtype=dtype, attn_block_q=attn_block_q,
+                      attn_block_k=attn_block_k)
         loss = layers.fused_softmax_ce_head(x, safe2d, vocab_size,
                                             name="lm_head")
         masked = ltensor.reshape(loss, [-1, 1]) * ltensor.reshape(
@@ -332,7 +345,8 @@ def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
         logits = gpt(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
                      d_model=d_model, d_ff=d_ff, max_len=max_len,
                      dropout_rate=dropout_rate, is_test=is_test,
-                     dtype=dtype)
+                     dtype=dtype, attn_block_q=attn_block_q,
+                     attn_block_k=attn_block_k)
         flat_logits = ltensor.reshape(logits, [-1, vocab_size])
         flat_labels = ltensor.reshape(safe2d, [-1, 1])
         loss = layers.softmax_with_cross_entropy(flat_logits, flat_labels)

@@ -87,7 +87,8 @@ def batched_step_logits(p, tok, t, cache_k, cache_v, n_layer, n_head,
         ctx = jnp.einsum("shT,sThd->shd", a, cv).reshape(S, d_model)
         x = x + ctx @ w("att_out.w") + w("att_out.b")
         h2 = _ln(x, w("ln2.scale"), w("ln2.bias"), eps)
-        ff = jax.nn.gelu(h2 @ w("ffn1.w") + w("ffn1.b"))
+        # exact erf gelu, matching transformer.generate and the gelu op
+        ff = jax.nn.gelu(h2 @ w("ffn1.w") + w("ffn1.b"), approximate=False)
         x = x + ff @ w("ffn2.w") + w("ffn2.b")
     x = _ln(x, p["ln_f.scale"], p["ln_f.bias"], eps)
     logits = jnp.matmul(x, p["lm_head.w"],

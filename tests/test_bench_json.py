@@ -1,0 +1,123 @@
+"""bench.py output-contract tests (ISSUE 3 satellite): the flagship JSON
+line must print — parseable, non-null value — even when a numeric gate
+fails; gate failures land as "gate_<name>": "FAILED: ..." strings in
+extra and only flip the rc."""
+
+import json
+
+import numpy as np
+import pytest
+
+import bench
+
+
+class _FakeDev:
+    platform = "tpu"
+
+
+@pytest.fixture
+def flagship_env(monkeypatch):
+    """Pretend an accelerator exists and both flagships produce numbers,
+    without running any real benchmark."""
+    monkeypatch.setattr(bench, "detect_devices", lambda: [_FakeDev()])
+    monkeypatch.setattr(bench, "bench_resnet",
+                        lambda *a, **k: (100.0, 90.0, 110.0))
+    monkeypatch.setattr(bench, "bench_gpt",
+                        lambda *a, **k: (1000.0, 0.31, 900.0, 1100.0))
+    monkeypatch.setenv("BENCH_MODELS", "resnet,gpt")
+    monkeypatch.delenv("BENCH_SMOKE", raising=False)
+    monkeypatch.delenv("BENCH_INFER", raising=False)
+
+
+def _run_main(capsys):
+    rc = bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, out
+    return rc, json.loads(out[0])
+
+
+def test_flagship_line_survives_failing_gate(flagship_env, monkeypatch,
+                                             capsys):
+    """Inject a failing gate: the flagship JSON line still prints with a
+    non-null value; the failure is a string in extra; rc is nonzero."""
+    def boom():
+        raise RuntimeError("injected gate failure")
+
+    monkeypatch.setattr(bench, "_gate_flash", boom)
+    monkeypatch.setattr(bench, "grad_numeric_gates", lambda: {"g": 1.0})
+    monkeypatch.setattr(bench, "_gate_mem", lambda: {"m": 1.0})
+    rc, row = _run_main(capsys)
+    assert rc != 0
+    assert row["metric"] == "resnet50_train_images_per_sec_per_chip"
+    assert row["value"] == 100.0  # NOT zeroed out by the gate failure
+    assert row["extra"]["gate_flash"].startswith("FAILED: RuntimeError")
+    assert row["extra"]["g"] == 1.0  # later gates still ran
+    assert row["extra"]["m"] == 1.0
+    assert row["extra"]["gpt_mfu"] == 0.31
+
+
+def test_every_gate_failing_still_prints_numbers(flagship_env, monkeypatch,
+                                                 capsys):
+    def boom(*a, **k):
+        raise MemoryError("RESOURCE_EXHAUSTED: 144 MB remat temps")
+
+    monkeypatch.setattr(bench, "_gate_flash", boom)
+    monkeypatch.setattr(bench, "grad_numeric_gates", boom)
+    monkeypatch.setattr(bench, "_gate_mem", boom)
+    rc, row = _run_main(capsys)
+    assert rc != 0
+    assert row["value"] == 100.0
+    for g in ("gate_flash", "gate_grad", "gate_mem"):
+        assert row["extra"][g].startswith("FAILED: MemoryError")
+
+
+def test_all_gates_passing_rc_zero(flagship_env, monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_gate_flash",
+                        lambda: {"flash_max_rel_err": 1e-6})
+    monkeypatch.setattr(bench, "grad_numeric_gates", lambda: {"g": 1.0})
+    monkeypatch.setattr(bench, "_gate_mem", lambda: {"m": 1.0})
+    rc, row = _run_main(capsys)
+    assert rc == 0
+    assert row["extra"]["flash_max_rel_err"] == 1e-6
+    assert not [k for k in row["extra"] if k.startswith("gate_")]
+
+
+def test_infer_rows_behind_env_guard(flagship_env, monkeypatch, capsys):
+    """BENCH_INFER=1 folds the benchmarks/inference.py rows into extra;
+    a failing row is isolated as a string like the gates."""
+    monkeypatch.setattr(bench, "_gate_flash", lambda: {})
+    monkeypatch.setattr(bench, "grad_numeric_gates", lambda: {})
+    monkeypatch.setattr(bench, "_gate_mem", lambda: {})
+
+    calls = []
+
+    def fake_rows(extra):
+        calls.append(True)
+        extra["infer_resnet_bs16_img_s"] = 250.0
+        extra["infer_capi"] = "FAILED: OSError: no libpaddle_tpu_capi"
+        return ["capi"]
+
+    monkeypatch.setattr(bench, "infer_rows", fake_rows)
+    rc, row = _run_main(capsys)
+    assert not calls  # guard off -> not invoked
+    monkeypatch.setenv("BENCH_INFER", "1")
+    rc, row = _run_main(capsys)
+    assert calls
+    assert rc != 0  # a failed row flips the rc like a failed gate
+    assert row["extra"]["infer_resnet_bs16_img_s"] == 250.0
+    assert row["extra"]["infer_capi"].startswith("FAILED:")
+
+
+def test_smoke_fallback_when_no_accelerator(monkeypatch, capsys):
+    """No accelerator: the CPU smoke row still prints one parseable JSON
+    line (the pre-existing contract, kept)."""
+    class _Cpu:
+        platform = "cpu"
+
+    monkeypatch.setattr(bench, "detect_devices", lambda: [_Cpu()])
+    monkeypatch.setattr(bench, "bench_smoke", lambda: 42.0)
+    rc = bench.main()
+    row = json.loads(capsys.readouterr().out.strip())
+    assert row["metric"] == "smoke_train_images_per_sec"
+    assert row["value"] == 42.0
+    assert rc == 0

@@ -2,6 +2,8 @@
 {alexnet,googlenet,smallnet_mnist_cifar}.py — SURVEY §6 baseline configs).
 Tiny-shape trainings: loss finite and decreasing, like tests/test_book.py."""
 
+import pytest
+
 import numpy as np
 
 from paddle_tpu.models import alexnet, googlenet, smallnet
@@ -19,6 +21,7 @@ def test_alexnet():
                 extra_fetch=[outs["accuracy"]])
 
 
+@pytest.mark.slow
 def test_googlenet():
     outs = googlenet.build(class_dim=4, image_shape=(3, 128, 128),
                            learning_rate=0.001, dtype="float32")

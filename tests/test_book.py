@@ -65,6 +65,7 @@ def test_recognize_digits_conv():
                 extra_fetch=[outs["accuracy"]])
 
 
+@pytest.mark.slow
 def test_image_classification_vgg():
     outs = vgg.build(depth=16, class_dim=4, image_shape=(3, 32, 32),
                      learning_rate=0.01)
@@ -210,6 +211,7 @@ def test_deep_speech2_ctc():
     train_steps(outs, feed, steps=4)
 
 
+@pytest.mark.slow
 def test_ssd_detection():
     """SSD family: multi-scale prior boxes + multibox_loss training, then
     detection_output inference recovers a planted box (the v1 SSD config

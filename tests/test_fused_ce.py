@@ -230,6 +230,7 @@ def test_block_chooser_preserves_flagship_and_shrinks_big_dmodel():
     assert bv < 1024 and bvf < 2048  # shrank to fit
 
 
+@pytest.mark.slow
 def test_fused_ce_d2048_v50k_interpret_matches_reference():
     """Large-d_model shape through the SAME code path (interpret mode):
     forward + dx + dW against the dense reference."""

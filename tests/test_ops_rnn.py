@@ -2,6 +2,8 @@
 (reference: test_lstm_op.py, test_gru_op.py, gserver test_LayerGrad RNN
 suites)."""
 
+import pytest
+
 import numpy as np
 
 from op_test import check_grad, run_op
@@ -32,6 +34,7 @@ def test_lstm_reverse_runs_backward():
     np.testing.assert_allclose(rev, fwd_flip[:, ::-1], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_lstm_grad():
     b, t, d = 2, 3, 2
     x = rng.randn(b, t, 4 * d).astype(np.float32)
@@ -53,6 +56,7 @@ def test_lstm_peephole_bias():
     assert got["Hidden"].shape == (b, t, d)
 
 
+@pytest.mark.slow
 def test_gru_shapes_mask_and_grad():
     b, t, d = 2, 4, 3
     x = rng.randn(b, t, 3 * d).astype(np.float32)

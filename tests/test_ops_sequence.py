@@ -92,6 +92,7 @@ def test_edit_distance():
     assert got["Out"][0, 0] == 2.0
 
 
+@pytest.mark.slow
 def test_warpctc_loss_and_grad():
     b, t, v, l = 2, 6, 5, 2
     logits = rng.randn(b, t, v).astype(np.float32)
@@ -158,6 +159,7 @@ def test_crf_decoding_picks_best_path():
     np.testing.assert_array_equal(got["ViterbiPath"][0], [0, 1, 2])
 
 
+@pytest.mark.slow
 def test_crf_grad():
     b, t, n = 2, 4, 3
     em = rng.randn(b, t, n).astype(np.float32)

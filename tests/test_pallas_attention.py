@@ -308,3 +308,237 @@ def test_flash_attention_packed_op_registered():
     )
     ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(out["Out"], pk(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_packed_geometry_paths_pinned():
+    """THE geometry decision table (ISSUE 3): which code path each
+    (n_head, d_head) takes — one lane-aligned head per slice, two paired
+    d=64 heads per slice, or no packed spelling at all (4-D fallback)."""
+    from paddle_tpu.ops.pallas_attention import packed_sub_heads
+
+    assert packed_sub_heads(6, 128) == 1    # flagship: lane-aligned
+    assert packed_sub_heads(1, 8) == 1      # single head: whole feature
+    assert packed_sub_heads(12, 64) == 2    # d64: two heads per slice
+    assert packed_sub_heads(4, 64) == 2
+    assert packed_sub_heads(3, 64) is None  # odd head count can't pair
+    assert packed_sub_heads(2, 8) is None   # narrow heads: 4-D fallback
+    assert packed_sub_heads(2, 256) == 1
+
+    # the layer builder must route accordingly
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    def attn_ops(d_model, n_head):
+        pt.core.unique_name.reset()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[16, d_model])
+            layers.multi_head_attention(x, x, x, d_model=d_model,
+                                        n_head=n_head, causal=True)
+        return {op.type for op in main.global_block().ops}
+
+    assert "flash_attention_packed" in attn_ops(256, 2)   # dh=128
+    assert "flash_attention_packed" in attn_ops(128, 2)   # dh=64 paired
+    assert "flash_attention" in attn_ops(48, 3)           # dh=16 fallback
+    assert "flash_attention_packed" not in attn_ops(48, 3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_packed_d64_paired_matches_reference(causal):
+    """d_head=64 packed layout (two heads per 128-lane slice, sub_heads=2
+    kernels): values and gradients vs the dense reference."""
+    from paddle_tpu.ops.pallas_attention import flash_attention_packed
+
+    rng = np.random.default_rng(9)
+    b, t, h, d = 2, 32, 4, 64
+    q4, k4, v4 = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5,
+                              jnp.float32) for _ in range(3))
+    pk = lambda x: x.reshape(b, t, h * d)
+    outp = flash_attention_packed(pk(q4), pk(k4), pk(v4), h, causal=causal,
+                                  block_q=16, block_k=16)
+    ref = attention_reference(q4, k4, v4, causal=causal)
+    np.testing.assert_allclose(np.asarray(outp), np.asarray(pk(ref)),
+                               atol=2e-5, rtol=2e-5)
+
+    def lp(q, k, v):
+        return jnp.sum(flash_attention_packed(
+            q, k, v, h, causal=causal, block_q=16, block_k=16) ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    gp = jax.grad(lp, (0, 1, 2))(pk(q4), pk(k4), pk(v4))
+    gr = jax.grad(lr, (0, 1, 2))(q4, k4, v4)
+    for a, r, nm in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(pk(r)),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"paired grad wrt {nm}")
+
+
+def test_flash_packed_d64_split_bwd_matches_fused(monkeypatch):
+    """d64 paired layout through the long-context split dq/dkv kernels."""
+    import paddle_tpu.ops.pallas_attention as pa
+
+    rng = np.random.default_rng(11)
+    b, t, h, d = 1, 32, 2, 64
+    q = jnp.asarray(rng.normal(size=(b, t, h * d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, t, h * d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, t, h * d)), jnp.float32)
+
+    def lp(q, k, v):
+        return jnp.sum(pa.flash_attention_packed(
+            q, k, v, h, causal=True, block_q=16, block_k=16) ** 2)
+
+    g_fused = jax.grad(lp, (0, 1, 2))(q, k, v)
+    monkeypatch.setattr(pa, "FUSED_BWD_PARTIAL_BYTES", 0)
+    g_split = jax.grad(lp, (0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_fused, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_causal_triangular_no_masked_half_flops():
+    """Flop accounting via the kernel's OWN sub-tile skip predicate
+    (``_diag_subtile_live`` is shared between the kernel and
+    ``causal_flash_flops``): the masked halves of diagonal blocks are
+    never scheduled — only the DIAG_W-wide band along the diagonal
+    remains, and no scheduled sub-tile lies fully above the diagonal."""
+    from paddle_tpu.ops.pallas_attention import (
+        DIAG_W, causal_flash_flops, _diag_subtile_live)
+
+    # flagship geometry: t=4096, 1024 blocks.  Old full-tile + select
+    # spelling scheduled ~1.25x the useful flops; triangular must be
+    # within the diagonal band bound (~1 + DIAG_W/t + slack).
+    sched, useful = causal_flash_flops(4096, 4096, 128, 1024, 1024)
+    assert sched / useful < 1.08, sched / useful
+    # old spelling for comparison: every cell at/below the block diagonal
+    # fully computed
+    nq = nk = 4096 // 1024
+    old = sum(min(((j + 1) * 1024 - 1) // 1024, nk - 1) + 1
+              for j in range(nq)) * 1024 * 1024 * 4 * 128
+    assert sched < 0.9 * old
+
+    # grid-shape assertion: a sub-tile fully above the diagonal is never
+    # live, and every unmasked sub-tile below it is
+    bq = bk = 1024
+    w = DIAG_W
+    for j in range(4):
+        for kb in range(4):
+            for qs in range(bq // w):
+                for ks in range(bk // w):
+                    row_last = j * bq + (qs + 1) * w - 1
+                    col0 = kb * bk + ks * w
+                    assert _diag_subtile_live(
+                        j, kb, qs, ks, bq, bk, w, w) == (col0 <= row_last)
+
+
+def test_causal_triangular_multi_subtile_matches_reference(monkeypatch):
+    """Force the multi-sub-tile triangular path (DIAG_W smaller than the
+    block) and check the forward against the dense reference — the
+    sub-tiled online softmax must reduce to the same attention."""
+    import paddle_tpu.ops.pallas_attention as pa
+
+    monkeypatch.setattr(pa, "DIAG_W", 32)
+    rng = np.random.default_rng(13)
+    b, t, h, d = 1, 256, 2, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5,
+                           jnp.float32) for _ in range(3))
+    o = pa.flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # uneven aspect: q blocks narrower than k blocks
+    o2 = pa.flash_attention(q, k, v, causal=True, block_q=64, block_k=128)
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_causal_triangular_multi_subtile_grads(monkeypatch):
+    """Gradients through the sub-tiled diagonal cells of BOTH backward
+    spellings (fused, and split dq/dkv with the partial budget forced to
+    0), vs the dense reference — the triangular pass covers the whole
+    causal step, not just the forward."""
+    import paddle_tpu.ops.pallas_attention as pa
+
+    monkeypatch.setattr(pa, "DIAG_W", 32)
+    rng = np.random.default_rng(17)
+    b, t, h, d = 1, 128, 2, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5,
+                           jnp.float32) for _ in range(3))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * jnp.cos(fn(q, k, v)))
+
+    flash = lambda q, k, v: pa.flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=64)
+    dense = lambda q, k, v: attention_reference(q, k, v, causal=True)
+    g_ref = jax.grad(loss(dense), (0, 1, 2))(q, k, v)
+    g_fused = jax.grad(loss(flash), (0, 1, 2))(q, k, v)
+    monkeypatch.setattr(pa, "FUSED_BWD_PARTIAL_BYTES", 0)
+    g_split = jax.grad(loss(flash), (0, 1, 2))(q, k, v)
+    for gf, gs, gr, nm in zip(g_fused, g_split, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-5, rtol=5e-4,
+                                   err_msg=f"fused tri grad wrt {nm}")
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gr),
+                                   atol=5e-5, rtol=5e-4,
+                                   err_msg=f"split tri grad wrt {nm}")
+
+    # d64 paired (sub_heads=2) through the sub-tiled diagonal as well
+    b, t, h, d = 1, 64, 2, 64
+    q2, k2, v2 = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5,
+                              jnp.float32) for _ in range(3))
+    pk = lambda x: x.reshape(b, t, h * d)
+
+    def lp(q, k, v):
+        return jnp.sum(pa.flash_attention_packed(
+            q, k, v, h, causal=True, block_q=64, block_k=64) ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gp = jax.grad(lp, (0, 1, 2))(pk(q2), pk(k2), pk(v2))
+    gr = jax.grad(lr, (0, 1, 2))(q2, k2, v2)
+    for a, r, nm in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(pk(r)),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"paired tri grad wrt {nm}")
+
+
+def test_packed_op_tp_odd_local_heads_falls_back_to_4d():
+    """TP regression: global n_head packs (d=64, 6 heads -> pairs) but
+    the per-shard count does not (6/2 = 3 local heads can't pair) — the
+    op must route each shard through the 4-D kernel instead of raising
+    at trace time, and still match the dense reference."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.ops.pallas_attention import (
+        flash_attention_packed_op, packed_sub_heads)
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    h, d = 6, 64
+    assert packed_sub_heads(h, d) == 2
+    assert packed_sub_heads(h // 2, d) is None
+
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+
+    class _Exe:
+        pass
+
+    class _Ctx:
+        executor = _Exe()
+
+    _Ctx.executor.mesh = mesh
+    rng = np.random.default_rng(21)
+    b, t = 2, 16
+    q4, k4, v4 = (jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5,
+                              jnp.float32) for _ in range(3))
+    pk = lambda x: jax.device_put(
+        x.reshape(b, t, h * d), NamedSharding(mesh, P(None, None, "tp")))
+    out = flash_attention_packed_op(
+        pk(q4), pk(k4), pk(v4), n_head=h, causal=True, _ctx=_Ctx())["Out"]
+    ref = attention_reference(q4, k4, v4, causal=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref.reshape(b, t, h * d)),
+                               atol=2e-5, rtol=2e-5)
