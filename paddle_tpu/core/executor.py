@@ -31,12 +31,85 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from ..observability import metrics as _obs
+from .memaudit import BLOCK_INPUT_TAG, KERNEL_RESIDUAL_TAG
 from .program import Program, Parameter, default_main_program, GRAD_SUFFIX
 from .registry import get_op_impl
 from .scope import Scope, global_scope, RNG_VAR
 from .place import CPUPlace, TPUPlace
+
+_pinned_host_cache = []
+
+
+def _pinned_host_available():
+    """True when device 0 exposes a ``pinned_host`` memory space (TPU/GPU
+    with memories enabled) — the offload policy's transfer target.  A
+    positive/negative ANSWER is cached per process; a transient probe
+    failure (backend not yet initialized) is NOT cached, so a later call
+    can still discover the memory space instead of silently pinning the
+    process to the degraded "save" mode."""
+    if not _pinned_host_cache:
+        try:
+            mems = jax.devices()[0].addressable_memories()
+        except Exception:
+            return False  # transient: do not cache
+        _pinned_host_cache.append(
+            any(m.kind == "pinned_host" for m in mems))
+    return _pinned_host_cache[0]
+
+
+def _offload_mode(program):
+    """How the scan body should run an offload-marked program:
+    ``"host"`` — stream block inputs to pinned host memory; ``"save"`` —
+    same name-policy checkpoint structure with block inputs left in
+    device memory (backends without a pinned_host space, e.g. CPU —
+    keeps the structure testable off-accelerator); ``"off"`` — not an
+    offload program, or killed via ``PADDLE_TPU_OFFLOAD=0`` (falls back
+    to plain selective execution)."""
+    if not getattr(program, "_offload", False):
+        return "off"
+    if os.environ.get("PADDLE_TPU_OFFLOAD", "1").lower() in (
+            "0", "", "false"):
+        return "off"
+    return "host" if _pinned_host_available() else "save"
+
+
+def _offload_ckpt_policy(mode):
+    """The name-based checkpoint policy for a wrapped sub-segment under
+    the offload policy: kernel residuals (should a kernel ever land
+    inside a wrapped segment) stay in device memory; block inputs are
+    offloaded (mode "host") or saved in place (mode "save"); everything
+    untagged rematerializes, exactly like a default ``jax.checkpoint``."""
+    cp = jax.checkpoint_policies
+    if mode == "host":
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[KERNEL_RESIDUAL_TAG],
+            names_which_can_be_offloaded=[BLOCK_INPUT_TAG],
+            offload_src="device", offload_dst="pinned_host")
+    return cp.save_only_these_names(
+        KERNEL_RESIDUAL_TAG, BLOCK_INPUT_TAG)
+
+
+def _scan_strict():
+    """PADDLE_TPU_SCAN_REMAT=strict: a uniform group that fails to scan
+    RAISES (with the classification error) instead of silently falling
+    back to the barrier spelling — the guard for capacity configs where
+    an unrolled backward means a runtime HBM OOM (BENCH_r05)."""
+    return os.environ.get("PADDLE_TPU_SCAN_REMAT", "").lower() == "strict"
+
+
+def _tag_named(v, tag):
+    """checkpoint_name for inexact arrays; anything else passes through
+    (names on integer/key values are pointless and some backends reject
+    them)."""
+    try:
+        if jnp.issubdtype(jnp.result_type(v), jnp.inexact):
+            return checkpoint_name(v, tag)
+    except TypeError:
+        pass
+    return v
 
 
 def _remat_segment(seg_fn, env, param_names=()):
@@ -280,15 +353,31 @@ class Executor:
                 cost["bytes_accessed"] = float(b) if b else None
         except Exception:
             pass  # some backends/plugins don't implement cost analysis
-        try:
-            mem = compiled.memory_analysis()
-            peak = getattr(mem, "peak_memory_in_bytes", 0) or (
-                mem.output_size_in_bytes + mem.temp_size_in_bytes)
+        from .memaudit import compiled_memory_stats
+
+        memstats = compiled_memory_stats(compiled)
+        if memstats:
+            # hbm_high_water_bytes: XLA's liveness-aware peak when the
+            # backend reports one, else argument+output+temp minus
+            # donation aliasing; temp_bytes: HLO temps alone (the figure
+            # the remat policies move).  Both land in last_step_cost (the
+            # bench/trainer JSON channel) and the registry.
+            temp = memstats["temp_bytes"]
+            high = memstats["hbm_high_water_bytes"]
+            peak = high or (memstats["output_bytes"] + temp)
             if peak:
                 cost["compiled_peak_bytes"] = int(peak)
                 reg.gauge("executor.compiled_peak_bytes").set_max(peak)
-        except Exception:
-            pass
+            cost["temp_bytes"] = temp
+            cost["hbm_high_water_bytes"] = high
+            reg.gauge(
+                "executor.temp_bytes",
+                help="HLO temp bytes of the largest compiled step",
+            ).set_max(temp)
+            reg.gauge(
+                "executor.hbm_high_water_bytes",
+                help="compiled-step HBM high-water (memory_analysis)",
+            ).set_max(high)
         return compiled, cost
 
     # ------------------------------------------------------------------
@@ -382,6 +471,34 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    def _run_entry(self, program, feed_names, fetch_names, state_names,
+                   state, feed_vals, feed_sig):
+        """The single-step executable for this signature — ``(entry,
+        cache_hit)`` — compiling (and caching) on miss.  Shared by
+        ``run`` and ``compile_only`` so preflighting primes exactly the
+        cache entry the real step will hit."""
+        key = (
+            program._serial,
+            program._version,
+            feed_sig,
+            tuple(fetch_names),
+            state_names,
+        )
+        reg = _obs.get_registry()
+        entry = self._cache.get(key)
+        if entry is not None:
+            reg.counter("executor.cache_hits").inc()
+            return entry, True
+        reg.counter("executor.cache_misses").inc()
+        _check_fetches(program, fetch_names)
+        jitted = self._compile(
+            program, feed_names, fetch_names, state_names)
+        entry = self._aot_compile(
+            jitted, (state,) + tuple(feed_vals),
+            f"run:{program._serial}v{program._version}")
+        self._cache[key] = entry
+        return entry, False
+
     def run(
         self,
         program=None,
@@ -392,33 +509,36 @@ class Executor:
     ):
         (program, scope, feed_names, fetch_names, feed_vals, state_names,
          state, feed_sig) = self._prepare(program, feed, fetch_list, scope)
-        key = (
-            program._serial,
-            program._version,
-            feed_sig,
-            tuple(fetch_names),
-            state_names,
-        )
-        reg = _obs.get_registry()
-        entry = self._cache.get(key)
-        cache_hit = entry is not None
-        if not cache_hit:
-            reg.counter("executor.cache_misses").inc()
-            _check_fetches(program, fetch_names)
-            jitted = self._compile(
-                program, feed_names, fetch_names, state_names)
-            entry = self._aot_compile(
-                jitted, (state,) + tuple(feed_vals),
-                f"run:{program._serial}v{program._version}")
-            self._cache[key] = entry
-        else:
-            reg.counter("executor.cache_hits").inc()
+        entry, cache_hit = self._run_entry(
+            program, feed_names, fetch_names, state_names, state,
+            feed_vals, feed_sig)
         step, cost = entry
         self.last_step_cost = dict(cost, cache_hit=cache_hit)
 
         new_state, fetches = step(state, *feed_vals)
         return self._finish(scope, new_state, fetch_names, fetches,
                             return_numpy)
+
+    # ------------------------------------------------------------------
+    def compile_only(self, program=None, feed=None, fetch_list=None,
+                     scope=None):
+        """AOT-compile the step for this (program, feed, fetch) signature
+        WITHOUT running it, priming the same cache ``run`` uses (the
+        following ``run`` is a cache hit, not a second compile).  Returns
+        a copy of the cost dict — compile_seconds, flops,
+        ``hbm_high_water_bytes``, ``temp_bytes`` — so callers can
+        preflight a capacity config against the chip's HBM before the
+        first real step allocates (bench.py's flagship fallback uses
+        this to turn a runtime allocator abort into a parseable
+        per-section failure)."""
+        (program, scope, feed_names, fetch_names, feed_vals, state_names,
+         state, feed_sig) = self._prepare(program, feed, fetch_list, scope)
+        entry, cache_hit = self._run_entry(
+            program, feed_names, fetch_names, state_names, state,
+            feed_vals, feed_sig)
+        _, cost = entry
+        self.last_step_cost = dict(cost, cache_hit=cache_hit)
+        return dict(cost)
 
     # ------------------------------------------------------------------
     def run_steps(
@@ -756,6 +876,26 @@ class Executor:
                                     for n in xs_names
                                 }
                                 carry0 = {n: e[n] for n in carry_map}
+                                # offload ("host"/"save"): the ONE change
+                                # vs plain selective execution is that
+                                # each wrapped sub-segment's checkpoint
+                                # gets a NAME policy and tags the
+                                # block-input (carry) args it consumes
+                                # BLOCK_INPUT_TAG inside the region — the
+                                # segment's backward recompute then reads
+                                # the carry from the saved named copy
+                                # (pinned host memory in mode "host")
+                                # instead of forcing the scan to stack it
+                                # in HBM.  The recompute op graph is
+                                # IDENTICAL to selective's (a default
+                                # jax.checkpoint saves nothing internal
+                                # either); only the residual's placement
+                                # moves — which is why offload is
+                                # bit-exact vs selective.
+                                off_mode = _offload_mode(program)
+                                ckpt_policy = (
+                                    _offload_ckpt_policy(off_mode)
+                                    if off_mode != "off" else None)
 
                                 def body(carry, xs):
                                     k_idx, xvals = xs
@@ -772,11 +912,22 @@ class Executor:
                                                 fctx, block, ops_j, e2,
                                                 inside_grad_prefix=True)
                                             continue
+                                        tags = (
+                                            frozenset(carry_map)
+                                            & set(uses_j)
+                                            if ckpt_policy is not None
+                                            else frozenset())
 
                                         def seg_fn(env_in, _ops=ops_j,
-                                                   _out=out_j, _c=cj):
+                                                   _out=out_j, _c=cj,
+                                                   _tags=tags):
                                             fctx._op_counter = _c
                                             e3 = dict(env_in)
+                                            for tn in _tags:
+                                                if tn in e3:
+                                                    e3[tn] = _tag_named(
+                                                        e3[tn],
+                                                        BLOCK_INPUT_TAG)
                                             run_block_ops(
                                                 fctx, block, _ops, e3,
                                                 inside_grad_prefix=True)
@@ -785,8 +936,9 @@ class Executor:
 
                                         env_sub = {u: e2[u] for u in uses_j
                                                    if u in e2}
-                                        e2.update(
-                                            jax.checkpoint(seg_fn)(env_sub))
+                                        e2.update(jax.checkpoint(
+                                            seg_fn,
+                                            policy=ckpt_policy)(env_sub))
                                     new_carry = {
                                         n: e2[carry_map[n]]
                                         for n in carry_map}
@@ -813,17 +965,33 @@ class Executor:
                                     {"start": i0, "period": P, "count": G,
                                      "carry": sorted(carry_map),
                                      "xs": len(xs_names),
-                                     "shared": len(shared_names)})
+                                     "shared": len(shared_names),
+                                     "offload": off_mode})
                                 return True
-                            except Exception:
+                            except Exception as exc:
                                 # classification/trace failure: restore the
                                 # rng counter and run the group segment by
-                                # segment through the barrier fallback
+                                # segment through the barrier fallback —
+                                # with the REASON recorded (a silent
+                                # fallback at a capacity config is a
+                                # runtime OOM waiting to happen: BENCH_r05)
                                 fctx._op_counter = c0
                                 reg.counter(
                                     "executor.scan_remat_fallbacks",
                                     help="segment groups that fell back to "
                                          "the barrier spelling").inc()
+                                reason = " ".join(
+                                    f"{type(exc).__name__}: {exc}"
+                                    .split())[:200]
+                                plan_log.append(
+                                    {"start": i0, "period": P, "count": G,
+                                     "fallback": reason})
+                                if _scan_strict():
+                                    raise RuntimeError(
+                                        f"PADDLE_TPU_SCAN_REMAT=strict: "
+                                        f"uniform group at segment {i0} "
+                                        f"(period {P} x {G}) failed to "
+                                        f"scan: {reason}") from exc
                                 return False
 
                         groups = _scan_groups_for(program, segments)

@@ -1,0 +1,224 @@
+"""Backward-pass memory audit: prove scan locality at the jaxpr/HLO level.
+
+The BENCH_r05 failure mode was invisible to the compile-succeeds check:
+every ``memory_optimize`` policy *compiled* at t=16k, but the flagship
+step died at runtime with ~20 per-layer ``bf16[6,16384,768]`` HLO temps
+coexisting at the flash-attention backward ``pallas_call``s — per-layer
+backward residuals alive across the whole layer stack instead of one
+layer at a time.  This module makes that property checkable without an
+accelerator:
+
+* ``jaxpr_report`` walks the step's jaxpr and reports every
+  ``pallas_call`` with its scan-nesting depth — the locality invariant is
+  "every flash call sits INSIDE a ``lax.scan`` body and none of its
+  operands/results carries a leading layer-count axis" (a ``[L, t, d]``
+  pallas operand means the per-layer kernel calls were stacked/hoisted
+  out of the loop, exactly the r05 shape);
+* ``audit_program`` lowers a Program through the Executor, builds the
+  report, and (CPU included — ``CompiledMemoryStats`` works on every
+  backend) attaches ``temp_bytes`` / ``hbm_high_water_bytes`` from
+  ``compiled.memory_analysis()`` plus optimized-HLO shape probes.
+
+The checkpoint-name tags shared by the kernels (``ops/pallas_attention``,
+``ops/pallas_ce``) and the Executor's offload scan body live here:
+under ``memory_optimize(policy="offload")`` each wrapped sub-segment's
+``jax.checkpoint`` carries a name policy that streams ``BLOCK_INPUT_TAG``
+values (the per-layer residual-stream inputs) to pinned host memory and
+keeps ``KERNEL_RESIDUAL_TAG`` values (custom-VJP kernel residuals) in
+device memory.
+"""
+
+import re
+
+import numpy as np
+
+__all__ = [
+    "KERNEL_RESIDUAL_TAG", "BLOCK_INPUT_TAG",
+    "jaxpr_report", "audit_program", "compiled_memory_stats",
+]
+
+# Residuals a custom-VJP kernel saves for its own backward (the flash
+# contract is exactly (q, k, v, o, lse); the fused CE head's is its lse).
+# Tagged INSIDE the kernels' fwd rules so a name-policy checkpoint keeps
+# them instead of re-running the kernel in the backward pass.
+KERNEL_RESIDUAL_TAG = "pt_kernel_res"
+
+# The per-layer block input (the residual stream entering each scanned
+# layer) — the one stacked [L, b, t, d] residual the offload policy
+# moves to pinned host memory on the forward scan and prefetches back
+# during the backward scan.
+BLOCK_INPUT_TAG = "pt_blk_in"
+
+
+def _jaxpr_types():
+    """(ClosedJaxpr, Jaxpr) from the supported ``jax.extend.core``
+    location, falling back to the legacy ``jax.core`` aliases on older
+    releases."""
+    try:
+        from jax.extend import core as _jex_core
+
+        return _jex_core.ClosedJaxpr, _jex_core.Jaxpr
+    except (ImportError, AttributeError):
+        import jax
+
+        return jax.core.ClosedJaxpr, jax.core.Jaxpr
+
+
+def _sub_jaxprs(eqn):
+    closed_t, jaxpr_t = _jaxpr_types()
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            if isinstance(x, closed_t):
+                yield x.jaxpr
+            elif isinstance(x, jaxpr_t):
+                yield x
+
+
+def _aval_bytes(aval):
+    try:
+        return int(np.prod(aval.shape) * np.dtype(aval.dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def jaxpr_report(jaxpr, layer_count=None):
+    """Walk a (Closed)Jaxpr and report kernel-call scan locality.
+
+    Returns a dict:
+
+    * ``pallas_calls``: one entry per ``pallas_call`` eqn —
+      ``{"scan_depth", "shapes"}`` (operand+result shapes);
+    * ``pallas_total`` / ``pallas_outside_scan``: counts (a backward
+      whose flash calls were unrolled per layer shows up here as
+      ``pallas_outside_scan > 0`` and ``pallas_total`` scaling with L);
+    * ``scan_lengths``: the ``length`` of every scan eqn;
+    * ``layer_stacked_pallas``: pallas operand/result shapes whose
+      LEADING dim equals ``layer_count`` — the hoisted-out-of-the-loop
+      form that exhausted HBM in BENCH_r05 (must be empty);
+    * ``residual_stacks``: outputs of layer-count-length scans with a
+      leading ``layer_count`` axis (the EXPECTED per-layer saved
+      residuals), largest first, as ``{"shape", "dtype", "bytes"}``.
+    """
+    closed_t, _ = _jaxpr_types()
+    if isinstance(jaxpr, closed_t):
+        jaxpr = jaxpr.jaxpr
+    report = {
+        "pallas_calls": [],
+        "pallas_total": 0,
+        "pallas_outside_scan": 0,
+        "scan_lengths": [],
+        "layer_stacked_pallas": [],
+        "residual_stacks": [],
+    }
+
+    def walk(jx, depth):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == "pallas_call":
+                shapes = [tuple(v.aval.shape)
+                          for v in list(eqn.invars) + list(eqn.outvars)
+                          if hasattr(v, "aval")
+                          and hasattr(v.aval, "shape")]
+                report["pallas_calls"].append(
+                    {"scan_depth": depth, "shapes": shapes})
+                report["pallas_total"] += 1
+                if depth == 0:
+                    report["pallas_outside_scan"] += 1
+                if layer_count:
+                    report["layer_stacked_pallas"] += [
+                        s for s in shapes
+                        if len(s) >= 2 and s[0] == layer_count]
+            if name == "scan":
+                length = eqn.params.get("length")
+                report["scan_lengths"].append(length)
+                if layer_count and length == layer_count:
+                    for v in eqn.outvars:
+                        aval = getattr(v, "aval", None)
+                        shape = getattr(aval, "shape", ())
+                        if len(shape) >= 1 and shape[0] == layer_count:
+                            report["residual_stacks"].append({
+                                "shape": tuple(shape),
+                                "dtype": str(aval.dtype),
+                                "bytes": _aval_bytes(aval),
+                            })
+            next_depth = depth + (1 if name in ("scan", "while") else 0)
+            for sub in _sub_jaxprs(eqn):
+                walk(sub, next_depth)
+
+    walk(jaxpr, 0)
+    report["residual_stacks"].sort(key=lambda r: -r["bytes"])
+    return report
+
+
+def compiled_memory_stats(compiled):
+    """``compiled.memory_analysis()`` flattened into the fields the rest
+    of the stack reports: ``temp_bytes``, ``argument_bytes``,
+    ``output_bytes``, and ``hbm_high_water_bytes`` (XLA's own
+    liveness-aware peak when the backend reports one, else
+    argument+output+temp minus donation aliasing).  ``{}`` when the
+    backend has no memory analysis."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if mem is None:
+        return {}
+    temp = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+    arg = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+    out = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+    alias = int(getattr(mem, "alias_size_in_bytes", 0) or 0)
+    peak = int(getattr(mem, "peak_memory_in_bytes", 0) or 0)
+    high = peak if peak else max(0, arg + out + temp - alias)
+    return {
+        "temp_bytes": temp,
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "hbm_high_water_bytes": high,
+    }
+
+
+def _shape_pattern(shape):
+    return re.compile(r"\[" + ",".join(str(int(s)) for s in shape) + r"\]")
+
+
+def audit_program(program, feed, fetch_list, scope=None, layer_count=None,
+                  compile_stats=True, absent_shapes=()):
+    """Lower ``program`` through a fresh Executor, trace the full step
+    (forward+backward+optimizer) and return ``jaxpr_report`` extended
+    with compile-time memory figures.
+
+    ``absent_shapes``: iterable of shape tuples that must NOT appear in
+    the optimized HLO text (e.g. ``(num_layers, t, d_model)`` — the
+    BENCH_r05 failure shape); hit counts land in
+    ``report["absent_shape_hits"]``.
+
+    The scope must already hold the program's parameters (run the
+    startup program into it first).  CPU-safe: used by the tier-1
+    regression test and ``python -m paddle_tpu --memory-selftest``.
+    """
+    import jax
+
+    from .executor import Executor
+
+    exe = Executor()
+    (program, scope, feed_names, fetch_names, feed_vals, state_names,
+     state, _sig) = exe._prepare(program, feed, fetch_list, scope)
+    step, _persist = exe.lower(program, feed_names, fetch_names, state_names)
+    # one trace serves both the jaxpr walk and (via .lower) the compile
+    traced = jax.jit(step).trace(state, *feed_vals)
+    report = jaxpr_report(traced.jaxpr, layer_count=layer_count)
+    report["scan_remat_plan"] = list(getattr(exe, "last_remat_plan", []) or [])
+    if compile_stats:
+        compiled = traced.lower().compile()
+        report.update(compiled_memory_stats(compiled))
+        if absent_shapes:
+            try:
+                text = compiled.as_text()
+            except Exception:
+                text = ""
+            report["absent_shape_hits"] = {
+                tuple(s): len(_shape_pattern(s).findall(text))
+                for s in absent_shapes
+            }
+    return report

@@ -167,16 +167,36 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
     liveness-minimal cuts, every segment rematerialized (recomputes flash
     too; measured −23% on the GPT flagship, RESULTS.md).
 
+    ``policy="offload"``: the selective saved set, with the per-layer
+    scan residuals (the block inputs — the residual stream entering each
+    scanned transformer layer) streamed to PINNED HOST memory on the
+    forward scan and prefetched back one layer ahead during the backward
+    scan.  A pure memory-PLACEMENT change relative to ``selective``: the
+    computation (and hence loss/grads) is identical; only the HBM
+    high-water drops by the stacked block-input residual.  Executed by
+    the Executor's scan-remat engine via a name-policy ``jax.checkpoint``
+    (``core/memaudit.py`` tags); outside scanned groups (prologue/
+    epilogue, non-uniform programs) it degrades to plain ``selective``.
+    Kill switch: ``PADDLE_TPU_OFFLOAD=0``; on backends without a
+    ``pinned_host`` memory space (CPU) the same checkpoint structure
+    runs with the block inputs left in device memory.
+
     Returns the segment list ``[(start, end, wrapped), ...]`` tiling the
     forward prefix."""
     from .core.program import default_main_program
 
     program = input_program or default_main_program()
     block = program.global_block()
-    if policy not in ("selective", "compact", "full"):
+    if policy not in ("selective", "compact", "full", "offload"):
         raise ValueError(
-            f"memory_optimize policy must be 'selective', 'compact' or "
-            f"'full', got {policy!r}")
+            f"memory_optimize policy must be 'selective', 'compact', "
+            f"'full' or 'offload', got {policy!r}")
+    # the offload flag rides on the program (the Executor's scan body
+    # reads it); segmentation below is exactly selective's
+    program._offload = policy == "offload"
+    policy_label = policy
+    if policy == "offload":
+        policy = "selective"
     bw = block.backward_index
     n_fwd = bw if bw is not None else len(block.ops)
     if n_fwd < 2 * min_segment:
@@ -219,8 +239,8 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
         program._bump_version()
         if print_log:
             n_wrap = sum(1 for _, _, w in segments if w)
-            print(f"memory_optimize[{policy}]: {len(segments)} segments, "
-                  f"{n_wrap} wrapped, expensive at {expensive_at}")
+            print(f"memory_optimize[{policy_label}]: {len(segments)} "
+                  f"segments, {n_wrap} wrapped, expensive at {expensive_at}")
         return segments
 
     # "full" policy: prefer cuts at the boundaries of the program's

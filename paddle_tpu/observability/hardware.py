@@ -17,7 +17,7 @@ from . import metrics as _metrics
 
 __all__ = [
     "PEAK_BF16", "device_peak_flops", "total_peak_flops", "mfu",
-    "device_memory_stats", "sample_memory",
+    "device_memory_stats", "sample_memory", "device_hbm_bytes",
 ]
 
 # bf16 peak FLOP/s by device_kind substring (public chip specs); order
@@ -81,6 +81,16 @@ def device_memory_stats(device=None):
     except Exception:
         return {}
     return dict(stats) if stats else {}
+
+
+def device_hbm_bytes(device=None):
+    """The device's usable memory capacity in bytes (the allocator's
+    ``bytes_limit``), or None when the backend does not report one (CPU).
+    The preflight ceiling bench.py checks a compiled step's
+    ``hbm_high_water_bytes`` against before running a capacity config."""
+    stats = device_memory_stats(device)
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
 
 
 def sample_memory(registry=None, devices=None):

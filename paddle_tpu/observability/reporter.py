@@ -110,6 +110,13 @@ class MetricsReporter:
                 flops=sc.get("flops"),
                 bytes_accessed=sc.get("bytes_accessed"),
                 hbm_high_water_bytes=self._last_mem.get("high_water"),
+                # static figures of the step EXECUTABLE (memory_analysis)
+                # vs the runtime allocator sample above: the pair
+                # separates "the program needs this much" from "the
+                # process is holding this much"
+                compiled_hbm_high_water_bytes=sc.get(
+                    "hbm_high_water_bytes"),
+                compiled_temp_bytes=sc.get("temp_bytes"),
             )
         if self.log_every_n and ev.batch_id % self.log_every_n == 0:
             self._print(self._summary_line(ev, wall, throughput, mfu_v,

@@ -30,6 +30,19 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
+
+from ..core.memaudit import KERNEL_RESIDUAL_TAG
+
+# The backward residual contract, pinned by tests/test_memory_engine.py:
+# the custom VJP recomputes p from EXACTLY these five arrays and closes
+# over nothing else.  q/k/v are upstream projection outputs (saved once,
+# shared with the matmul residuals), o is the kernel's own output, lse is
+# the narrow 2-D [b*h, t] softmax statistic.  Anything beyond this set
+# (a saved p tile, a delta row, a replicated lse) multiplies per-layer
+# residual memory at long context — at the t=16k flagship every extra
+# bf16 [b, t, d] residual is 144 MB/layer.
+FLASH_BWD_RESIDUALS = ("q", "k", "v", "o", "lse")
 
 NEG_INF = -1e30
 LSE_LANES = 128  # Mosaic min lane tile (in-kernel m/l scratch width);
@@ -928,6 +941,13 @@ def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
     o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                         interpret, n_head=n_head,
                         sub_heads=_sub_heads_for(n_head, q))
+    # FLASH_BWD_RESIDUALS contract: tag the kernel-owned residuals (o is
+    # ALSO the primal output — one tagged value, saved once) so a
+    # name-policy checkpoint (memory_optimize(policy="offload")) keeps
+    # them instead of re-running the forward kernel in the backward pass.
+    # Outside a name-policy region the tag is an identity no-op.
+    o = checkpoint_name(o, KERNEL_RESIDUAL_TAG)
+    lse = checkpoint_name(lse, KERNEL_RESIDUAL_TAG)
     return o, (q, k, v, o, lse)
 
 
@@ -976,6 +996,10 @@ def _flash_core_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                         interpret):
     o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                         interpret)
+    # same FLASH_BWD_RESIDUALS tagging as _flash_core_fwd (o and lse are
+    # both primal outputs here — still one tagged value each)
+    o = checkpoint_name(o, KERNEL_RESIDUAL_TAG)
+    lse = checkpoint_name(lse, KERNEL_RESIDUAL_TAG)
     return (o, lse), (q, k, v, o, lse)
 
 

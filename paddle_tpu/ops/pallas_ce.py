@@ -32,6 +32,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.ad_checkpoint import checkpoint_name
+
+from ..core.memaudit import KERNEL_RESIDUAL_TAG
 from ..core.registry import register_op
 from .pallas_attention import _pick_block
 
@@ -225,6 +228,10 @@ def _ce_core(x, w, y, blocks, interpret):
 
 def _ce_core_fwd(x, w, y, blocks, interpret):
     loss, lse = _ce_fwd(x, w, y, blocks[0], blocks[2], interpret)
+    # kernel-residual tag (see ops/pallas_attention.py): a name-policy
+    # checkpoint saves the O(tokens) lse instead of re-running the
+    # O(tokens x vocab) forward kernel in the backward pass
+    lse = checkpoint_name(lse, KERNEL_RESIDUAL_TAG)
     return loss, (x, w, y, lse)
 
 
@@ -249,6 +256,7 @@ def _ce_core_lse(x, w, y, blocks, interpret):
 
 def _ce_core_lse_fwd(x, w, y, blocks, interpret):
     loss, lse = _ce_fwd(x, w, y, blocks[0], blocks[2], interpret)
+    lse = checkpoint_name(lse, KERNEL_RESIDUAL_TAG)
     return (loss, lse), (x, w, y, lse)
 
 

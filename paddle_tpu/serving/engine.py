@@ -354,6 +354,54 @@ class ServingEngine:
         self._thread = None
 
     # -- internals --------------------------------------------------------
+    def _aot_with_mem_telemetry(self, fn, label):
+        """Wrap a jitted entry point so its FIRST call compiles AOT
+        (``lower().compile()`` — the same single compile the lazy jit
+        path would do) and the executable's ``memory_analysis()`` lands
+        in the ``serving.hbm_high_water_bytes`` / ``serving.temp_bytes``
+        gauges; later calls reuse the executable.  Every call site feeds
+        fixed shapes (bucketed prefill, the decode chunk), so the AOT
+        executable serves all of them.  Backends without AOT fall back
+        to the plain jit callable."""
+        from ..core.memaudit import compiled_memory_stats
+
+        box = {}
+
+        def call(*args):
+            c = box.get("c")
+            if c is None:
+                try:
+                    c = fn.lower(*args).compile()
+                except Exception:
+                    box["c"] = fn  # no AOT on this backend: plain jit
+                    return fn(*args)
+                box["c"] = c
+                stats = compiled_memory_stats(c)
+                if stats:
+                    self._reg.gauge(
+                        "serving.hbm_high_water_bytes", label=label,
+                        help="compiled-executable HBM high-water "
+                             "(memory_analysis)",
+                    ).set_max(stats["hbm_high_water_bytes"])
+                    self._reg.gauge(
+                        "serving.temp_bytes", label=label,
+                        help="compiled-executable HLO temp bytes",
+                    ).set_max(stats["temp_bytes"])
+            return c(*args)
+
+        def cache_size():
+            # executable count, same contract as jit's _cache_size():
+            # the compile-bound tests assert exactly one per entry point
+            c = box.get("c")
+            if c is None:
+                return 0
+            if c is fn:
+                return fn._cache_size()
+            return 1
+
+        call._cache_size = cache_size
+        return call
+
     def bucket_for(self, p_len):
         """Prefill bucket for a prompt length: the smallest power-of-two
         multiple of ``min_bucket`` that covers it, capped at
@@ -366,9 +414,11 @@ class ServingEngine:
     def _prefill_fn(self, bucket):
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            fn = _bd.make_prefill(self.n_layer, self.n_head, self.d_model,
-                                  bucket, self.max_len, eps=self._eps,
-                                  donate=self._donate)
+            fn = self._aot_with_mem_telemetry(
+                _bd.make_prefill(self.n_layer, self.n_head, self.d_model,
+                                 bucket, self.max_len, eps=self._eps,
+                                 donate=self._donate),
+                label=f"prefill_{bucket}")
             self._prefill_fns[bucket] = fn
             self._reg.counter(
                 "serving.prefill_compiles",
@@ -378,9 +428,11 @@ class ServingEngine:
 
     def _decode(self):
         if self._decode_fn is None:
-            self._decode_fn = _bd.make_decode_chunk(
-                self.n_layer, self.n_head, self.d_model,
-                self.decode_chunk, eps=self._eps, donate=self._donate)
+            self._decode_fn = self._aot_with_mem_telemetry(
+                _bd.make_decode_chunk(
+                    self.n_layer, self.n_head, self.d_model,
+                    self.decode_chunk, eps=self._eps, donate=self._donate),
+                label="decode")
             self._reg.counter(
                 "serving.decode_compiles",
                 help="decode-chunk executables built (one per engine)",

@@ -28,6 +28,17 @@ if ! python -m paddle_tpu --metrics-selftest > /tmp/_t1_selftest.log 2>&1; then
     cat /tmp/_t1_selftest.log >&2
     exit 1
 fi
+# backward-pass memory smoke: the no-accelerator scan-locality /
+# memory_analysis regression (docs/memory.md invariants) run explicitly —
+# all four memory_optimize policies must keep their flash kernel calls
+# scan-local and offload must stay bit-exact vs selective
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --memory-selftest \
+        > /tmp/_t1_memtest.log 2>&1; then
+    echo "TIER1 REGRESSION: memory selftest failed" >&2
+    cat /tmp/_t1_memtest.log >&2
+    exit 1
+fi
 # serving smoke: the continuous-batching engine must beat the sequential
 # single-stream baseline (asserted inside --smoke) and print ONE
 # parseable JSON row with the throughput/latency/compile fields
