@@ -228,6 +228,13 @@ def _bench_gpt_at(seq, n_chips, mesh_factory, steps, warmup, extra):
     cap = device_hbm_bytes(jax.devices()[0])
     extra["gpt_hbm_high_water_bytes"] = high
     extra["gpt_temp_bytes"] = cost0.get("temp_bytes")
+    if mesh is not None:
+        # multi-chip comm accounting of the compiled step (the full
+        # scaling story lives in benchmarks/multichip.py; these ride the
+        # flagship row so regressions show up in BENCH json too)
+        extra["gpt_collective_bytes"] = cost0.get("collective_bytes")
+        extra["gpt_collective_count"] = cost0.get("collective_count")
+        extra["gpt_reduce_ops_in_loop"] = cost0.get("reduce_ops_in_loop")
     if cap and high and high > cap:
         raise MemoryError(
             f"RESOURCE_EXHAUSTED (preflight): compiled hbm high-water "

@@ -1,5 +1,7 @@
 """Data-parallel ResNet over a device mesh — one annotation replaces the
-reference's MultiGradientMachine/parallel_do/NCCL stack.
+reference's MultiGradientMachine/parallel_do/NCCL stack.  Optimizer
+state (the Momentum velocities here) shards automatically over the dp
+axis — ZeRO-1, docs/parallel.md; ``PADDLE_TPU_ZERO=0`` replicates.
 
 Runs on real chips, or on a virtual mesh:
 
@@ -33,6 +35,11 @@ def main():
 
     exe = pt.Executor(mesh=mesh)
     exe.run(pt.default_startup_program())
+
+    rep = parallel.optimizer_state_report(pt.default_main_program(), mesh)
+    print(f"optimizer state: {rep['total_bytes'] / 1e6:.2f} MB total, "
+          f"{rep['per_device_bytes'] / 1e6:.2f} MB/device "
+          f"({rep['sharded_vars']} ZeRO-sharded vars)")
 
     rng = np.random.default_rng(0)
     batch = 8 * n  # global batch; shards across dp automatically

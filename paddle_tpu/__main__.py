@@ -386,6 +386,136 @@ def cmd_memory_selftest(args=None):
     return 1 if failures else 0
 
 
+def cmd_multichip_selftest(args=None):
+    """``python -m paddle_tpu --multichip-selftest``: the multi-chip
+    scaling invariants on an 8-device virtual CPU mesh, run explicitly —
+    ZeRO-1 accumulator sharding present with per-device optimizer-state
+    bytes <= replicated/4, the comm audit's one-cross-chip-gradient-
+    reduction-per-optimizer-step contract under accum_steps=4
+    (``reduce_ops_in_loop == 0`` on compiled HLO, accumulation plan in
+    ``local`` mode), and loss/params BIT-EXACT vs the replicated
+    (``PADDLE_TPU_ZERO=0``) spelling on the same mesh.  Exits 0 on
+    success; wired into tools/tier1.sh (docs/parallel.md)."""
+    n = 8
+    # strip-and-replace the device-count flag (a pre-set lower count must
+    # not survive — the dryrun_multichip convention)
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n or jax.devices()[0].platform != "cpu":
+        # backend was already initialized without the virtual mesh (e.g.
+        # called from a process holding a real chip): re-exec clean —
+        # ONCE (the child sets the env above before its backend exists,
+        # so a second level means something else is broken)
+        if os.environ.get("_PT_MULTICHIP_SELFTEST_CHILD"):
+            print(f"FAIL cannot provision {n} cpu devices "
+                  f"(have {len(jax.devices())} "
+                  f"{jax.devices()[0].platform!r})")
+            return 1
+        import subprocess
+
+        env = dict(os.environ)
+        for k in list(env):
+            if "AXON" in k or k.startswith(("TPU_", "PJRT_")):
+                env.pop(k)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["_PT_MULTICHIP_SELFTEST_CHILD"] = "1"
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "--multichip-selftest"],
+            env=env, timeout=1800)
+        return proc.returncode
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel import api as papi
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    failures = []
+
+    def check(cond, what):
+        (failures.append(what) if not cond else None)
+        print(("ok   " if cond else "FAIL ") + what)
+
+    cfg = dict(vocab_size=256, n_layer=2, n_head=2, d_model=64,
+               max_len=32, dropout_rate=0.0, dtype="float32",
+               learning_rate=1e-2)
+    accum = 4
+    mesh = make_mesh({"dp": n})
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, cfg["vocab_size"], (4 * n, 32)).astype(np.int64)
+    lbls = np.roll(toks, -1, axis=1)
+    lbls[:, -1] = -1
+    feed = {"tokens": toks, "labels": lbls}
+
+    def train(zero):
+        os.environ["PADDLE_TPU_ZERO"] = zero
+        try:
+            pt.core.unique_name.reset()
+            main_prog, startup = pt.Program(), pt.Program()
+            main_prog.random_seed = 7
+            with pt.program_guard(main_prog, startup):
+                outs = transformer.build(**cfg)
+            pt.gradient_accumulation(main_prog, accum)
+            papi.data_parallel(main_prog, "dp", programs=(startup,))
+            scope = pt.Scope()
+            pt.core.scope._scope_stack.append(scope)
+            try:
+                exe = pt.Executor(mesh=mesh)
+                exe.run(startup, scope=scope)
+                losses = [
+                    np.asarray(exe.run(
+                        main_prog, feed=feed,
+                        fetch_list=[outs["avg_cost"]], scope=scope)[0])
+                    for _ in range(2)
+                ]
+                params = {v.name: np.asarray(scope.get(v.name))
+                          for v in main_prog.all_parameters()}
+                moments = sorted(
+                    v.name for v in main_prog.global_block().vars.values()
+                    if v.name.endswith("_moment1"))
+                sh = scope.get(moments[0]).sharding
+                return (losses, params, dict(exe.last_step_cost),
+                        exe.last_accum_plan,
+                        papi.optimizer_state_report(main_prog, mesh), sh)
+            finally:
+                pt.core.scope._scope_stack.pop()
+        finally:
+            os.environ.pop("PADDLE_TPU_ZERO", None)
+
+    losses, params, cost, plan, rep, moment_sh = train("1")
+    check(rep["sharded_vars"] > 0
+          and "dp" in str(getattr(moment_sh, "spec", "")),
+          f"ZeRO-1 accumulators dp-sharded ({rep['sharded_vars']} vars, "
+          f"moment spec {getattr(moment_sh, 'spec', None)})")
+    check(rep["per_device_bytes"] * 4 <= rep["total_bytes"],
+          f"optimizer-state bytes/device {rep['per_device_bytes']} <= "
+          f"replicated {rep['total_bytes']} / 4")
+    check((plan or {}).get("mode") == "local",
+          f"accumulation plan is comm-aware local mode ({plan})")
+    check(cost.get("reduce_ops_in_loop") == 0
+          and (cost.get("reduce_ops") or 0) > 0,
+          f"one cross-chip gradient reduction per optimizer step "
+          f"(reduce_ops={cost.get('reduce_ops')}, "
+          f"in_loop={cost.get('reduce_ops_in_loop')})")
+    losses_r, params_r, _cost_r, _plan_r, rep_r, _sh_r = train("0")
+    check(rep_r["sharded_vars"] == 0
+          and rep_r["per_device_bytes"] == rep_r["total_bytes"],
+          "PADDLE_TPU_ZERO=0 replicates every accumulator")
+    check(all(np.array_equal(a, b) for a, b in zip(losses, losses_r)),
+          "ZeRO loss bit-exact vs replicated spelling")
+    check(all(np.array_equal(params[k], params_r[k]) for k in params),
+          "ZeRO updated params bit-exact vs replicated spelling")
+
+    print("multichip selftest " + ("FAILED" if failures else "PASSED"))
+    return 1 if failures else 0
+
+
 def main(argv=None):
     from .flags import init_flags
 
@@ -395,6 +525,8 @@ def main(argv=None):
         return cmd_metrics_selftest()
     if "--memory-selftest" in argv:
         return cmd_memory_selftest()
+    if "--multichip-selftest" in argv:
+        return cmd_multichip_selftest()
 
     p = argparse.ArgumentParser(prog="paddle_tpu")
     sub = p.add_subparsers(dest="command", required=True)

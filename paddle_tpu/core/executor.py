@@ -218,6 +218,18 @@ def _rng_op_count(ops):
     return n
 
 
+def _rng_op_count_deep(program, ops, seen=None):
+    """_rng_op_count including sub-blocks (control-flow bodies)."""
+    seen = set() if seen is None else seen
+    n = _rng_op_count(ops)
+    for op in ops:
+        sub = op.attrs.get("sub_block")
+        if sub is not None and sub not in seen:
+            seen.add(sub)
+            n += _rng_op_count_deep(program, program.block(sub).ops, seen)
+    return n
+
+
 class LoweringCtx:
     """Passed to raw (control-flow) op implementations so they can lower
     sub-blocks with the same machinery."""
@@ -320,9 +332,15 @@ class Executor:
         )
         self._cache = {}
         # Telemetry of the most recent run()/run_steps(): compile_seconds,
-        # static flops / bytes_accessed from XLA cost analysis, cache_hit.
-        # The Trainer reads this to report achieved MFU per step.
+        # static flops / bytes_accessed from XLA cost analysis, cache_hit,
+        # and (mesh runs) the cross-chip collective accounting from the
+        # compiled HLO.  The Trainer reads this to report achieved MFU
+        # per step.
         self.last_step_cost = None
+        # Most recent compile's gradient-accumulation comm plan
+        # ({"mode": "local"|"reduce_each", ...}) — the accumulation
+        # analogue of last_remat_plan.  None when the step has no accum.
+        self.last_accum_plan = None
 
     def _aot_compile(self, jitted, args, label):
         """Explicit ``lower().compile()`` instead of first-call jit, so
@@ -378,6 +396,48 @@ class Executor:
                 "executor.hbm_high_water_bytes",
                 help="compiled-step HBM high-water (memory_analysis)",
             ).set_max(high)
+        if self.mesh is not None:
+            # cross-chip communication accounting (memaudit.comm_report):
+            # static collective op counts/bytes of the compiled step, with
+            # the load-bearing loop split — a reduce op inside a while
+            # body pays once per microbatch, one outside pays once per
+            # step.  Lands in last_step_cost (bench/trainer JSON channel)
+            # and the registry, mirroring the hbm_high_water plumbing.
+            from .memaudit import comm_report
+
+            comm = comm_report(compiled)
+            if comm:
+                cost["collective_count"] = comm["collective_count"]
+                cost["collective_bytes"] = comm["collective_bytes"]
+                # per-kind counts under a DISTINCT key: "collective_ops"
+                # stays the scalar count everywhere scalar-valued (the
+                # executor.collective_ops gauge, trainer JSONL)
+                cost["collective_op_kinds"] = dict(comm["collective_ops"])
+                cost["reduce_ops"] = comm["reduce_ops"]
+                cost["reduce_bytes"] = comm["reduce_bytes"]
+                if label.startswith("scan"):
+                    # run_steps fuses N optimizer steps into ONE while
+                    # loop: the per-step boundary reduction is
+                    # structurally "in loop" there, so the
+                    # one-reduce-per-step invariant does not apply —
+                    # emit None rather than a false regression signal
+                    cost["reduce_ops_in_loop"] = None
+                    cost["collectives_in_loop"] = None
+                else:
+                    cost["reduce_ops_in_loop"] = comm["reduce_ops_in_loop"]
+                    cost["collectives_in_loop"] = comm[
+                        "collectives_in_loop"]
+                reg.gauge(
+                    "executor.collective_ops",
+                    help="collective ops in the largest compiled step",
+                ).set_max(comm["collective_count"])
+                reg.gauge(
+                    "executor.collective_bytes",
+                    help="static collective bytes of the largest "
+                         "compiled step",
+                ).set_max(comm["collective_bytes"])
+        if self.last_accum_plan is not None:
+            cost["accum_comm"] = dict(self.last_accum_plan)
         return compiled, cost
 
     # ------------------------------------------------------------------
@@ -657,6 +717,7 @@ class Executor:
         Returns ``(step, persist_out)`` where persist_out names the state
         entries the step emits.  Exposed for embedding the framework in
         external jit pipelines (e.g. the driver's compile checks)."""
+        self.last_accum_plan = None
         block = program.global_block()
         bw = block.backward_index
         info = program._backward_info.get(0)
@@ -1061,8 +1122,31 @@ class Executor:
                         program, block, ctx, env, tparams, make_fwd,
                         feed_names, persist_out, accum, step_key, bw)
                     env.update(aux)
-                for n, g in grads.items():
-                    env[n + GRAD_SUFFIX] = g
+                if self.mesh is not None:
+                    # Pin each gradient at the backward/optimizer boundary
+                    # to its PARAMETER's sharding (replicated under plain
+                    # dp, the tp spec for tp-sharded params).  ZeRO-1
+                    # shards optimizer STATE, not gradients — without this
+                    # pin the sharded-moment annotations propagate back
+                    # through the grads into the whole backward pass,
+                    # repartitioning it (measured: extra in-loop
+                    # collectives in the attention scans and loss/params
+                    # drifting from the replicated spelling).  With it the
+                    # backward is bit-identical to ZeRO-off and only the
+                    # update math reads the grad shard-locally.
+                    from jax.sharding import (
+                        NamedSharding, PartitionSpec as _P)
+
+                    for n, g in grads.items():
+                        var = block._find_var(n)
+                        spec = (getattr(var, "partition_spec", None)
+                                if var is not None else None) or _P()
+                        env[n + GRAD_SUFFIX] = (
+                            jax.lax.with_sharding_constraint(
+                                g, NamedSharding(self.mesh, spec)))
+                else:
+                    for n, g in grads.items():
+                        env[n + GRAD_SUFFIX] = g
                 run_block_ops(ctx, block, block.ops[bw:], env)
 
             new_state = {n: env[n] for n in persist_out}
@@ -1071,6 +1155,50 @@ class Executor:
             return new_state, fetches
 
         return step, persist_out
+
+    def _accum_comm_mode(self, program, block, bw, mbs, carry_persist,
+                         ndp):
+        """Pick the accumulation-loop communication spelling:
+        ``("local", None)`` — accumulate per-device partial gradients in a
+        dp-sharded carry and cross-chip-reduce ONCE at the optimizer
+        boundary; ``("reduce_each", reason)`` — the reference spelling
+        whose per-microbatch gradients are full cross-chip values (GSPMD
+        reduces — or worse, gathers the batch and replicates compute —
+        inside the loop body).  Local mode needs every condition below;
+        the reason string lands in ``last_accum_plan`` so a silent
+        de-optimization is observable (the scan-remat fallback
+        discipline)."""
+        if ndp <= 1:
+            return "reduce_each", "no dp mesh axis"
+        if os.environ.get("PADDLE_TPU_LOCAL_ACCUM", "1").lower() in (
+                "0", "", "false"):
+            return "reduce_each", "PADDLE_TPU_LOCAL_ACCUM=0"
+        if not mbs:
+            return "reduce_each", "no batch feeds to split"
+        bad = sorted(n for n, mb in mbs.items() if mb % ndp)
+        if bad:
+            return "reduce_each", (
+                f"microbatch not divisible by dp={ndp}: {bad}")
+        unsharded = []
+        for n in mbs:
+            var = block._find_var(n)
+            spec = getattr(var, "partition_spec", None) if var else None
+            if spec is None or not len(spec) or spec[0] != "dp":
+                unsharded.append(n)
+        if unsharded:
+            return "reduce_each", (
+                f"feeds not dp-batch-sharded: {sorted(unsharded)}")
+        if carry_persist:
+            # BN stats / metric accumulators couple device groups across
+            # the batch axis — vmapped lanes would each write their own
+            return "reduce_each", (
+                f"forward-written persistables: {carry_persist[:3]}")
+        if _rng_op_count_deep(program, block.ops[:bw]):
+            # the per-lane computation shares one op key under vmap, so
+            # every device group would draw the SAME dropout mask —
+            # valid dropout, but not the unsharded key stream
+            return "reduce_each", "stateful rng ops in the forward"
+        return "local", None
 
     def _accum_grads(self, program, block, ctx, env, tparams, make_fwd,
                      feed_names, persist_out, accum, step_key, bw):
@@ -1081,7 +1209,16 @@ class Executor:
         gradient — the big-batch average-loss gradient when microbatches
         weigh equally.  Forward-written persistables (BN stats, metric
         accumulators) thread through the scan carry so microbatch k+1 sees
-        k's updates, exactly as consecutive small steps would."""
+        k's updates, exactly as consecutive small steps would.
+
+        On a mesh with a dp axis the COMM-AWARE spelling
+        (``_accum_grads_local``) is preferred: the reference spelling
+        below makes every microbatch's gradient a full cross-chip value,
+        so GSPMD either reduces inside the loop body (accum x the
+        collective bytes) or — observed on the CPU SPMD partitioner —
+        all-gathers the whole batch and REPLICATES the accumulation loop
+        on every chip.  Eligibility and fallback reasons:
+        ``_accum_comm_mode`` / ``last_accum_plan``."""
         mbs = {}
         for n in feed_names:
             if jnp.ndim(env[n]) == 0:
@@ -1100,6 +1237,47 @@ class Executor:
         carry_persist = sorted(
             n for n in persist_out if n in fwd_written and n in env
         )
+        # aux names the forward merely passes through (optimizer-op state
+        # inputs: moments, beta pows, lr — and the params themselves):
+        # their env values are already authoritative, and stacking them
+        # per microbatch both wastes scan-ys memory and MISCLASSIFIES in
+        # the reassembly when a state var's leading dim happens to equal
+        # the feed batch (e.g. a [max_len, d] positional-embedding moment
+        # at batch == max_len would be "batch-leading"-reshaped).
+        passthrough = {
+            v.name for v in program.persistable_vars()
+            if v.name not in fwd_written
+        } | set(tparams)
+
+        from ..parallel.mesh import axis_size
+
+        ndp = axis_size(self.mesh, "dp")
+        reg = _obs.get_registry()
+        mode, reason = self._accum_comm_mode(
+            program, block, bw, mbs, carry_persist, ndp)
+        self.last_accum_plan = {"mode": mode, "accum": accum, "dp": ndp}
+        if reason:
+            self.last_accum_plan["reason"] = reason
+        if mode == "local":
+            try:
+                out = self._accum_grads_local(
+                    program, block, env, tparams, make_fwd, accum,
+                    step_key, bw, mbs, full_b, ndp, passthrough)
+                reg.counter(
+                    "executor.accum_local_steps",
+                    help="steps compiled with boundary-reduced (local) "
+                         "gradient accumulation").inc()
+                return out
+            except Exception as exc:  # trace failure: reference spelling
+                reg.counter(
+                    "executor.accum_local_fallbacks",
+                    help="accum steps that fell back to per-microbatch "
+                         "reduction").inc()
+                why = " ".join(
+                    f"{type(exc).__name__}: {exc}".split())[:200]
+                self.last_accum_plan = {
+                    "mode": "reduce_each", "accum": accum, "dp": ndp,
+                    "reason": f"local spelling failed: {why}"}
 
         def one_micro(carry, i):
             gacc, persist = carry
@@ -1114,12 +1292,12 @@ class Executor:
             gacc = jax.tree_util.tree_map(
                 lambda a, gi: a + gi.astype(jnp.float32), gacc, g)
             new_persist = {n: aux[n] for n in carry_persist}
-            # parameters are optimizer-op inputs, so they sit in aux too —
-            # but the forward never writes them and env already holds the
-            # exact values; stacking them across the scan would cost
-            # accum x param-bytes of HBM for nothing
+            # params and unwritten optimizer state sit in aux too
+            # (optimizer-op inputs) but env already holds the exact
+            # values; stacking them across the scan would cost
+            # accum x state-bytes of HBM for nothing (see ``passthrough``)
             ys = {n: v for n, v in aux.items()
-                  if n not in new_persist and n not in tparams}
+                  if n not in new_persist and n not in passthrough}
             return (gacc, new_persist), ys
 
         g0 = jax.tree_util.tree_map(
@@ -1130,6 +1308,96 @@ class Executor:
         grads = {
             n: (gsum[n] / accum).astype(env[n].dtype) for n in gsum
         }
+        aux = dict(persist_f)
+        aux.update(self._reassemble_accum_aux(block, env, ys, full_b, bw))
+        return grads, aux
+
+    def _accum_grads_local(self, program, block, env, tparams, make_fwd,
+                           accum, step_key, bw, mbs, full_b, ndp,
+                           passthrough):
+        """Comm-aware gradient accumulation: one cross-chip gradient
+        reduction per OPTIMIZER step instead of one per microbatch.
+
+        The batch is regrouped so each microbatch is the union of every
+        device's k-th local slice: feed ``[B, ...]`` (dp-sharded) reshapes
+        to ``[ndp, accum, B/(ndp*accum), ...]`` — a shard-local reshape —
+        and transposes to scan xs ``[accum, ndp, mb_g, ...]`` with the
+        GROUP axis sharded over dp.  The microbatch forward+backward runs
+        ``jax.vmap``-ed over that group axis, so every lane's compute is
+        resident on one chip and the loop body carries NO collectives
+        (``memaudit.comm_report: reduce_ops_in_loop == 0`` — also killing
+        the batch-axis gathers GSPMD otherwise inserts for in-loop
+        dynamic slicing).  Per-lane gradients accumulate in a dp-sharded
+        ``[ndp, ...]`` float32 carry (per-device bytes == one replicated
+        gradient buffer); the single sum over the group axis at the
+        boundary is where XLA emits the one cross-chip reduction, feeding
+        the ZeRO-sharded optimizer update directly.
+
+        Numerics: grads are the mean over (microbatch, group) lanes —
+        exactly the reference spelling's mean-of-equal-weight-microbatch
+        gradients, refined to device groups (the documented
+        equal-weight-mean-loss contract of ``gradient_accumulation``);
+        float summation ORDER differs, so vs dp=1 this is
+        close-not-bit-identical, like any resharding."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = self.mesh
+
+        def dp_sharded(x, lead=0):
+            spec = PartitionSpec(*([None] * lead + ["dp"]))
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+
+        xs_feeds = {}
+        for n, mb in mbs.items():
+            v = env[n]
+            mb_g = mb // ndp
+            g = dp_sharded(jnp.reshape(
+                v, (ndp, accum, mb_g) + tuple(v.shape[1:])))
+            xs_feeds[n] = dp_sharded(jnp.moveaxis(g, 0, 1), lead=1)
+
+        def one_micro(gacc, xs):
+            i, feeds_k = xs
+            fctx = LoweringCtx(
+                self, program, jax.random.fold_in(step_key, i + 1))
+            fwd = make_fwd(fctx)
+
+            def lane(feeds_lane):
+                e0 = dict(env)
+                e0.update(feeds_lane)
+                return jax.grad(fwd, has_aux=True)(tparams, e0)
+
+            g, aux = jax.vmap(lane)(feeds_k)
+            gacc = jax.tree_util.tree_map(
+                lambda a, gi: dp_sharded(a + gi.astype(jnp.float32)),
+                gacc, g)
+            # params/unwritten optimizer state sit in aux (optimizer-op
+            # inputs) but env already holds them — stacking
+            # [accum, ndp, ...] copies would burn accum x ndp x
+            # state-bytes of scan-ys for nothing
+            ys = {n: v for n, v in aux.items() if n not in passthrough}
+            return gacc, ys
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: dp_sharded(
+                jnp.zeros((ndp,) + tuple(jnp.shape(p)), jnp.float32)),
+            tparams)
+        gacc, ys = jax.lax.scan(
+            one_micro, g0, (jnp.arange(accum), xs_feeds))
+        grads = {
+            n: (jnp.sum(gacc[n], axis=0) / (ndp * accum)).astype(
+                env[n].dtype)
+            for n in gacc
+        }
+        return grads, self._reassemble_accum_aux(
+            block, env, ys, full_b, bw, local_ndp=ndp)
+
+    def _reassemble_accum_aux(self, block, env, ys, full_b, bw,
+                              local_ndp=0):
+        """Reassemble scan-stacked aux fetches back to their big-batch
+        values.  ``ys`` entries carry a leading ``[accum, ...]`` axis —
+        or ``[accum, ndp, ...]`` when ``local_ndp`` is set (the
+        comm-aware path's vmapped device groups)."""
         producer = {}
         for op in block.ops[:bw]:
             for out_n in op.output_names():
@@ -1141,7 +1409,7 @@ class Executor:
             return len(vshape) >= 1 and (
                 vshape[0] == -1 or (full_b and vshape[0] == full_b))
 
-        aux = dict(persist_f)
+        aux = {}
 
         # additive combiners through which batch-sum-ness propagates
         # linearly: sum(microbatch values) reassembles the big-batch value
@@ -1201,28 +1469,38 @@ class Executor:
                 return all(flags) and bool(flags)
             return False
 
+        lead = 2 if local_ndp else 1
         for n, y in ys.items():
             # classify by the var's STATIC leading dim, not the runtime
             # shape (a [1]-shaped mean fetch with microbatch 1 must not be
             # mistaken for batch data): -1 or the full feed batch means
             # batch-leading -> microbatch results concatenate back.
-            if y.ndim >= 2 and _static_batch_leading(n):
-                aux[n] = y.reshape((-1,) + y.shape[2:])
+            if y.ndim >= lead + 1 and _static_batch_leading(n):
+                if local_ndp:
+                    # [accum, ndp, mb_g, ...] -> device-major, then
+                    # microbatch, then row: the exact original global
+                    # batch order (each device's shard was split into
+                    # accum contiguous slices)
+                    aux[n] = jnp.moveaxis(y, 0, 1).reshape(
+                        (-1,) + y.shape[3:])
+                else:
+                    aux[n] = y.reshape((-1,) + y.shape[2:])
                 continue
+            axes = tuple(range(lead))
             if _is_batch_sum(n):
                 # a reduction OVER the batch: the big-batch sum is the
-                # sum of the microbatch sums.  (reduce_sum of batch-
-                # independent tensors — weight norms — is microbatch-
-                # invariant and falls through to the mean, which is then
-                # exact.)
-                aux[n] = jnp.sum(y, axis=0)
+                # sum of the microbatch (x group) sums.  (reduce_sum of
+                # batch-independent tensors — weight norms — is
+                # microbatch-invariant and falls through to the mean,
+                # which is then exact.)
+                aux[n] = jnp.sum(y, axis=axes)
             elif jnp.issubdtype(y.dtype, jnp.inexact):
                 # scalar metrics (avg loss): mean of equal-weight
-                # microbatch averages == the big-batch average
-                aux[n] = jnp.mean(y, axis=0)
+                # microbatch (x group) averages == the big-batch average
+                aux[n] = jnp.mean(y, axis=axes)
             else:
-                aux[n] = y[-1]
-        return grads, aux
+                aux[n] = y[(-1,) * lead] if local_ndp else y[-1]
+        return aux
 
     def _compile(self, program, feed_names, fetch_names, state_names):
         step, persist_out = self.lower(

@@ -117,6 +117,11 @@ class MetricsReporter:
                 compiled_hbm_high_water_bytes=sc.get(
                     "hbm_high_water_bytes"),
                 compiled_temp_bytes=sc.get("temp_bytes"),
+                # cross-chip comm accounting of the compiled step (mesh
+                # runs only — memaudit.comm_report via the Executor)
+                collective_count=sc.get("collective_count"),
+                collective_bytes=sc.get("collective_bytes"),
+                reduce_ops_in_loop=sc.get("reduce_ops_in_loop"),
             )
         if self.log_every_n and ev.batch_id % self.log_every_n == 0:
             self._print(self._summary_line(ev, wall, throughput, mfu_v,

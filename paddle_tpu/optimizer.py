@@ -45,15 +45,27 @@ class Optimizer:
 
     # -- plumbing ----------------------------------------------------------
     def _create_persistable(self, block, name, shape, dtype, init_value,
-                            startup_program=None):
+                            startup_program=None, zero_param=None):
         sp = startup_program or default_startup_program()
         var = block.create_var(
             name=name, shape=shape, dtype=dtype, persistable=True,
             stop_gradient=True,
         )
+        # optimizer-owned state: the vars parallel/api.py's ZeRO-1 pass
+        # accounts (and, for per-parameter accumulators — zero_param set —
+        # shards over the dp mesh axis).  Tagged on the MAIN var and the
+        # startup twin: compile_shardings resolves each program against
+        # its own block, and the initial zeros must be created already
+        # sharded or the first step pays a layout reshard.
+        var.optimizer_state = True
+        if zero_param is not None:
+            var.zero_param = zero_param
         sb = sp.global_block()
         if name not in sb.vars:
             svar = sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True)
+            svar.optimizer_state = True
+            if zero_param is not None:
+                svar.zero_param = zero_param
             init_mod.Constant(init_value)(svar, sb)
         return var
 
@@ -85,12 +97,19 @@ class Optimizer:
 
     def _add_accumulator(self, block, name, param, init_value=0.0, shape=None,
                          startup_program=None):
+        """Per-parameter optimizer accumulator (Adam/Momentum/Adagrad
+        moments etc.).  ``zero_param`` marks it ZeRO-1-shardable: when the
+        Executor compiles over a mesh with a ``dp`` axis,
+        ``parallel.api.zero_spec_for`` shards its leading axis over dp
+        (fallback rules there) — beta-pow/lr scalars go through
+        ``_create_persistable`` directly and stay replicated."""
         key = (name, param.name)
         if key in self._accumulators:
             return self._accumulators[key]
         var = self._create_persistable(
             block, f"{param.name}_{name}", shape or list(param.shape),
             "float32", init_value, startup_program,
+            zero_param=param.name,
         )
         self._accumulators[key] = var
         return var

@@ -19,13 +19,17 @@ Mesh axis conventions: dp (data) · tp (tensor) · pp (pipeline) ·
 sp (sequence/context) · ep (expert).
 """
 
-from .mesh import make_mesh, single_host_mesh
+from .mesh import make_mesh, single_host_mesh, axis_size
 from .api import (
     compile_shardings,
     data_parallel,
     shard_parameter,
     replicate,
     P,
+    zero_spec_for,
+    optimizer_state_report,
+    comm_overlap_flags,
+    enable_comm_overlap,
 )
 from .ring_attention import ring_attention, blockwise_attention
 from .pipeline import pipeline, stack_stage_params
@@ -33,8 +37,9 @@ from .moe import init_moe_params, moe_ffn
 from . import sparse
 
 __all__ = [
-    "make_mesh", "single_host_mesh", "compile_shardings", "data_parallel",
-    "shard_parameter", "replicate", "P", "ring_attention",
-    "blockwise_attention", "pipeline", "stack_stage_params",
-    "init_moe_params", "moe_ffn", "sparse",
+    "make_mesh", "single_host_mesh", "axis_size", "compile_shardings",
+    "data_parallel", "shard_parameter", "replicate", "P", "zero_spec_for",
+    "optimizer_state_report", "comm_overlap_flags", "enable_comm_overlap",
+    "ring_attention", "blockwise_attention", "pipeline",
+    "stack_stage_params", "init_moe_params", "moe_ffn", "sparse",
 ]

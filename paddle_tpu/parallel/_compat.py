@@ -1,0 +1,25 @@
+"""jax API compatibility for the parallel package.
+
+``shard_map`` moved between jax releases (``jax.experimental.shard_map``
+on 0.4.x, top-level ``jax.shard_map`` later) and renamed its replication
+check (``check_rep`` -> ``check_vma``); resolve both once here so the
+pipeline / ring-attention / MoE recipes run on either."""
+
+import inspect
+
+import jax
+
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)
+
+__all__ = ["shard_map"]

@@ -6,24 +6,90 @@ all-reduce).  The TPU-native mechanism keeps ONE program and annotates
 variables with PartitionSpecs; jax.jit + GSPMD partitions the computation
 and inserts ICI collectives.  These helpers set the annotations; the
 Executor (core/executor.py) turns them into in_shardings/out_shardings.
+
+ZeRO-1 optimizer-state sharding rides the same mechanism: optimizer
+accumulators (Adam/Momentum/Adagrad moments — tagged ``zero_param`` by
+``Optimizer._add_accumulator``) resolve to a PartitionSpec sharding their
+leading axis over the ``dp`` mesh axis, so XLA stores each chip's shard
+of the moments, updates it against that shard of the gradient, and
+all-gathers only the updated parameters.  Contract and fallback rules in
+``zero_spec_for`` (docs/parallel.md).
 """
 
+import os
 import re
+
+import numpy as np
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.scope import RNG_VAR
+from .mesh import axis_size
 
 __all__ = ["compile_shardings", "data_parallel", "shard_parameter",
-           "replicate", "P"]
+           "replicate", "P", "zero_spec_for", "optimizer_state_report",
+           "comm_overlap_flags", "enable_comm_overlap"]
 
 
-def _spec_for(var, mesh):
+def _zero_enabled():
+    """ZeRO-1 accumulator sharding kill switch (``PADDLE_TPU_ZERO=0``):
+    with it off every accumulator is replicated exactly as before the
+    scaling engine existed — the bit-exactness reference spelling."""
+    return os.environ.get("PADDLE_TPU_ZERO", "1").lower() not in (
+        "0", "", "false")
+
+
+def zero_spec_for(var, mesh, block=None):
+    """The ZeRO-1 PartitionSpec for one optimizer accumulator, or None.
+
+    Rules (docs/parallel.md):
+    * only vars tagged ``zero_param`` (per-parameter accumulators) are
+      candidates — beta-pow/learning-rate scalars never shard;
+    * an explicit ``partition_spec`` always wins (callers check first);
+    * the accumulator inherits its parameter's PartitionSpec (so a
+      tensor-parallel ``[d, 4d]`` FFN weight's moments stay tp-sharded
+      next to it), then its LEADING axis is sharded over ``dp`` iff that
+      axis is free, the dim divides the dp size, and no other axis
+      already uses ``dp``;
+    * uneven/small shapes (leading dim not divisible — scalars, odd
+      embeddings) fall back to the inherited spec, or full replication.
+    """
+    if not _zero_enabled():
+        return None
+    ndp = axis_size(mesh, "dp")
+    pname = getattr(var, "zero_param", None)
+    if ndp <= 1 or pname is None:
+        return None
+    shape = tuple(var.shape or ())
+    if not shape:
+        return None
+    base = [None] * len(shape)
+    if block is not None:
+        pvar = block._find_var(pname)
+        pspec = getattr(pvar, "partition_spec", None) if pvar else None
+        if pspec is not None:
+            if len(pspec) > len(shape):
+                return None  # shape mismatch: stay replicated
+            base[:len(pspec)] = list(pspec)
+    used = {a for e in base if e for a in
+            (e if isinstance(e, tuple) else (e,))}
+    if (base[0] is None and "dp" not in used and shape[0]
+            and int(shape[0]) % ndp == 0):
+        base[0] = "dp"
+    if all(e is None for e in base):
+        return None
+    return P(*base)
+
+
+def _spec_for(var, mesh, block=None):
     spec = getattr(var, "partition_spec", None)
-    if spec is None:
-        return P()
-    return spec
+    if spec is not None:
+        return spec
+    spec = zero_spec_for(var, mesh, block)
+    if spec is not None:
+        return spec
+    return P()
 
 
 def compile_shardings(mesh, program, feed_names, fetch_names, state_names,
@@ -39,7 +105,7 @@ def compile_shardings(mesh, program, feed_names, fetch_names, state_names,
 
     def var_sharding(name):
         var = block._find_var(name)
-        return ns(_spec_for(var, mesh) if var else P())
+        return ns(_spec_for(var, mesh, block) if var else P())
 
     state_shardings = {n: var_sharding(n) for n in state_names}
     state_shardings[RNG_VAR] = ns(P())
@@ -95,3 +161,108 @@ def shard_parameters_by_rule(program, rules):
 def replicate(var):
     var.partition_spec = P()
     return var
+
+
+def optimizer_state_report(program, mesh):
+    """Static accounting of optimizer-state memory under the resolved
+    shardings — the figure ZeRO-1 exists to shrink.  Walks every
+    optimizer-owned persistable (``optimizer_state`` tag: accumulators,
+    beta-pows, the lr var) and returns::
+
+        {"total_bytes":               sum of full (logical) state bytes,
+         "per_device_bytes":          sum of each var's shard bytes,
+         "replicated_per_device_bytes": total_bytes (the ZeRO-off figure),
+         "sharded_vars": n, "replicated_vars": n,
+         "vars": {name: {"bytes", "per_device_bytes", "spec"}}}
+
+    Pure metadata — no arrays are touched, so it also works pre-startup
+    and is what ``benchmarks/multichip.py`` and the multichip selftest
+    gate (``per_device_bytes <= replicated/4`` on the dp=8 mesh)."""
+    block = program.global_block()
+    mesh_sizes = (dict(zip(mesh.axis_names, mesh.devices.shape))
+                  if mesh is not None else {})
+    out = {"total_bytes": 0, "per_device_bytes": 0,
+           "sharded_vars": 0, "replicated_vars": 0, "vars": {}}
+    for var in block.vars.values():
+        if not getattr(var, "optimizer_state", False):
+            continue
+        shape = tuple(abs(int(s)) for s in (var.shape or ()))
+        numel = int(np.prod(shape)) if shape else 1
+        try:
+            itemsize = np.dtype(
+                var.dtype.name if hasattr(var.dtype, "name")
+                else var.dtype).itemsize
+        except TypeError:
+            itemsize = 4
+        nbytes = numel * itemsize
+        spec = _spec_for(var, mesh, block)
+        frac = 1
+        for entry in spec:
+            for ax in (entry if isinstance(entry, tuple)
+                       else (entry,) if entry else ()):
+                frac *= mesh_sizes.get(ax, 1)
+        out["total_bytes"] += nbytes
+        out["per_device_bytes"] += nbytes // max(frac, 1)
+        out["sharded_vars" if frac > 1 else "replicated_vars"] += 1
+        out["vars"][var.name] = {
+            "bytes": nbytes, "per_device_bytes": nbytes // max(frac, 1),
+            "spec": str(spec)}
+    out["replicated_per_device_bytes"] = out["total_bytes"]
+    return out
+
+
+# XLA's latency-hiding scheduler overlaps the gradient all-gather/
+# reduce with backward compute instead of serializing at the step tail.
+# These are libtpu-registered options: the open-source CPU/GPU builds
+# ABORT on unknown XLA_FLAGS, so they are only emitted for tpu.
+_TPU_OVERLAP_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+)
+_GPU_OVERLAP_FLAGS = (
+    "--xla_gpu_enable_latency_hiding_scheduler=true",
+)
+
+
+def comm_overlap_flags(platform):
+    """The latency-hiding-scheduler XLA flags for ``platform`` ("tpu" /
+    "gpu" / "cpu"), as a tuple.  Empty off-accelerator: XLA aborts on
+    flags its build did not register, and the CPU collective emulation
+    has nothing to overlap anyway."""
+    return {"tpu": _TPU_OVERLAP_FLAGS,
+            "gpu": _GPU_OVERLAP_FLAGS}.get(platform, ())
+
+
+def enable_comm_overlap(platform=None):
+    """Thread the overlap flags into ``XLA_FLAGS`` (idempotent).  Honors
+    the ``PADDLE_TPU_COMM_OVERLAP`` knob (default on; ``0`` disables) and
+    must run BEFORE the jax backend initializes — XLA parses the env once.
+    Returns the flags applied (possibly ())."""
+    if os.environ.get("PADDLE_TPU_COMM_OVERLAP", "1").lower() in (
+            "0", "", "false"):
+        return ()
+    if platform is None:
+        platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+        if not platform:
+            # a TPU VM normally leaves JAX_PLATFORMS unset — defaulting
+            # to "cpu" there would silently skip the flags this function
+            # exists to set, so probe for the TPU runtime instead (no
+            # backend init: XLA_FLAGS must still be settable after)
+            import importlib.util as _ilu
+
+            platform = "tpu" if (
+                _ilu.find_spec("libtpu") is not None
+                or _ilu.find_spec("libtpu_nightly") is not None) else "cpu"
+    flags = comm_overlap_flags(platform)
+    if not flags:
+        return ()
+    current = os.environ.get("XLA_FLAGS", "")
+    # compare tokenized flag KEYS, not substrings: one overlap flag's key
+    # is a prefix of another's, and a substring check would silently drop
+    # the shorter one when the longer is already set
+    present = {t.split("=")[0] for t in current.split()}
+    missing = [f for f in flags if f.split("=")[0] not in present]
+    if missing:
+        os.environ["XLA_FLAGS"] = " ".join([current] + missing).strip()
+    return flags

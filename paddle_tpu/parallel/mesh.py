@@ -34,6 +34,18 @@ def make_mesh(axes, devices=None):
     return Mesh(arr, names)
 
 
+def axis_size(mesh, name):
+    """Size of a named mesh axis, 0 when the mesh lacks it (or is None) —
+    the guard every dp-conditional path uses (ZeRO sharding, the
+    comm-aware accumulation loop) without special-casing meshless runs."""
+    if mesh is None:
+        return 0
+    try:
+        return int(dict(zip(mesh.axis_names, mesh.devices.shape))[name])
+    except KeyError:
+        return 0
+
+
 def single_host_mesh(dp=-1, tp=1, sp=1):
     """Convenience: all local devices in a dp×tp×sp mesh (dp inferred)."""
     axes = {"dp": dp, "tp": tp, "sp": sp}

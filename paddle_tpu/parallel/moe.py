@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ._compat import shard_map
+
 __all__ = ["init_moe_params", "moe_ffn"]
 
 
@@ -130,7 +132,7 @@ def moe_ffn(params, x, mesh, axis_name="ep", capacity_factor=2.0,
         out = jnp.einsum("nec,ecd->nd", combine.astype(y.dtype), y)
         return out, jax.lax.pmean(aux, axis_name)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn, mesh=mesh,
         in_specs=({"gate": P(), "w1": P(axis_name), "b1": P(axis_name),
                    "w2": P(axis_name), "b2": P(axis_name)}, P(axis_name)),

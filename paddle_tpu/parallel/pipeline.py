@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ._compat import shard_map
+
 __all__ = ["pipeline", "pipeline_lm", "stack_stage_params"]
 
 
@@ -165,7 +167,7 @@ def pipeline(stage_fn, stacked_params, x, mesh, axis_name="pp",
         xspec = P(None, batch_axis, *wire_spec)
     else:
         xspec = P(None, batch_axis) if batch_axis else P()
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(None, axis_name), xspec), out_specs=xspec,
         check_vma=False,
@@ -232,7 +234,7 @@ def pipeline_lm(embed_fn, stage_fn, head_loss_fn, embed_params,
         return loss
 
     xspec = P(None, batch_axis) if batch_axis else P()
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(), P(None, axis_name), P(), xspec, xspec),
         out_specs=P(),

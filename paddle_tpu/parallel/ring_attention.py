@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ._compat import shard_map
+
 
 def _attn_block(q, k, v, bias=None, scale=None):
     """One dense block: returns (unnormalized out, row logsumexp-style stats).
@@ -107,7 +109,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
 
     sp = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda qb, kb, vb: ring_attention_local(
             qb, kb, vb, sp, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -217,7 +219,7 @@ def _ring_attention_flash(q, k, v, mesh, axis_name, causal, block_q,
         return o.astype(q_blk.dtype)
 
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

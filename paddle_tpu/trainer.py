@@ -140,9 +140,12 @@ class Trainer:
         if prefetch:
             from .reader import prefetch_to_device
 
+            feed_sharding = self._feed_shardings()
+
             def batches():
                 return iter(prefetch_to_device(
-                    reader, prefetch, self.feeder.feed)())
+                    reader, prefetch, self.feeder.feed,
+                    sharding=feed_sharding)())
         else:
             # keep feeder.feed inside the per-batch timer (as before this
             # path existed): raw batches here, convert in the loop below
@@ -193,6 +196,29 @@ class Trainer:
         finally:
             if ckpt is not None:
                 ckpt.close()
+
+    def _feed_shardings(self):
+        """Per-feed NamedShardings when the executor is mesh-bound (None
+        otherwise): the prefetch thread then device_puts each batch
+        PRE-SHARDED — batch axis split over dp per the vars' annotations —
+        so the step consumes it directly instead of resharding a
+        replicated array on entry."""
+        mesh = self.exe.mesh
+        if mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .parallel.api import _spec_for
+
+        block = self.main_program.global_block()
+        out = {}
+        for v in self.feed_list:
+            name = v.name if hasattr(v, "name") else str(v)
+            var = block._find_var(name)
+            spec = _spec_for(var, mesh) if var is not None else (
+                PartitionSpec())
+            out[name] = NamedSharding(mesh, spec)
+        return out
 
     def _peak_flops(self):
         """Aggregate peak FLOP/s of the devices a step runs on (cached)."""

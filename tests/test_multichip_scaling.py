@@ -1,0 +1,439 @@
+"""Multi-chip scaling engine on the 8-device CPU mesh: ZeRO-1
+optimizer-state sharding (bit-exact vs the replicated spelling),
+comm-aware gradient accumulation (one cross-chip gradient reduction per
+optimizer step, audited on compiled HLO), the compile_shardings
+resolution contract, pre-sharded prefetch, and the scaling-benchmark
+row.  docs/parallel.md documents every invariant pinned here."""
+
+import importlib.util
+import os
+
+import numpy as np
+import jax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.memaudit import hlo_comm_report
+from paddle_tpu.core.scope import RNG_VAR
+from paddle_tpu.models import transformer
+from paddle_tpu.parallel import api as papi
+from paddle_tpu.parallel.mesh import axis_size, make_mesh
+
+
+VOCAB, LAYERS, HEADS, DMODEL, SEQ = 128, 2, 2, 32, 16
+BATCH = 32  # accum=4 on dp=8: microbatch 8, one sample per device group
+
+
+def _mesh(n=8):
+    return make_mesh({"dp": n}, devices=jax.devices()[:n])
+
+
+def _build_gpt(accum=1, dropout=0.0):
+    pt.core.unique_name.reset()
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 7
+    with pt.program_guard(main, startup):
+        outs = transformer.build(
+            vocab_size=VOCAB, n_layer=LAYERS, n_head=HEADS,
+            d_model=DMODEL, max_len=SEQ, dropout_rate=dropout,
+            dtype="float32", learning_rate=1e-2)
+    if accum > 1:
+        pt.gradient_accumulation(main, accum)
+    return main, startup, outs
+
+
+def _build_mlp(make_opt):
+    pt.core.unique_name.reset()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[16])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(input=x, size=24, act="tanh")
+        pred = layers.fc(input=h, size=1)
+        loss = layers.mean(layers.square(pred - y))
+        make_opt().minimize(loss)
+    return main, startup, loss
+
+
+def _gpt_feed(batch=BATCH, seed=5):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, VOCAB, (batch, SEQ)).astype(np.int64)
+    lbls = np.roll(toks, -1, axis=1)
+    lbls[:, -1] = -1
+    return {"tokens": toks, "labels": lbls}
+
+
+def _train(build, feed, loss_name, mesh, steps=2, zero=True):
+    """(losses, params, last_step_cost, accum_plan, scope arrays fn)."""
+    os.environ["PADDLE_TPU_ZERO"] = "1" if zero else "0"
+    try:
+        main, startup, outs = build()
+        loss = outs[loss_name] if isinstance(outs, dict) else outs
+        if mesh is not None:
+            papi.data_parallel(main, "dp", programs=(startup,))
+        scope = pt.Scope()
+        pt.core.scope._scope_stack.append(scope)
+        try:
+            exe = pt.Executor(mesh=mesh)
+            exe.run(startup, scope=scope)
+            losses = [np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss], scope=scope)[0])
+                      for _ in range(steps)]
+            params = {v.name: np.asarray(scope.get(v.name))
+                      for v in main.all_parameters()}
+            state = {n: scope.get(n) for n in
+                     (v.name for v in main.global_block().vars.values())
+                     if scope.find_var(n) is not None}
+            return (losses, params, dict(exe.last_step_cost),
+                    exe.last_accum_plan, main, state)
+        finally:
+            pt.core.scope._scope_stack.pop()
+    finally:
+        os.environ.pop("PADDLE_TPU_ZERO", None)
+
+
+# -- compile_shardings resolution -------------------------------------------
+def test_compile_shardings_resolution():
+    """Feeds shard over dp, fetches and RNG replicate, ZeRO accumulators
+    shard their leading axis, beta-pow scalars replicate, an explicit
+    partition_spec wins, and out_state_names may diverge from
+    state_names (startup-created persistables)."""
+    main, startup, outs = _build_gpt()
+    mesh = _mesh()
+    papi.data_parallel(main, "dp", programs=(startup,))
+    block = main.global_block()
+    moments = sorted(n for n in block.vars if n.endswith("_moment1"))
+    betas = sorted(n for n in block.vars if n.startswith("beta1_pow"))
+    assert moments and betas
+    pinned = moments[-1]
+    block.vars[pinned].partition_spec = P()  # explicit spec wins
+
+    state_names = [moments[0], betas[0], pinned]
+    (state_sh, *feed_sh), (out_state, fetch_sh) = papi.compile_shardings(
+        mesh, main, ["labels", "tokens"], [outs["avg_cost"].name],
+        state_names, out_state_names=state_names + [moments[1]])
+    assert all(sh.spec[0] == "dp" for sh in feed_sh)
+    assert fetch_sh[0].spec == P()
+    assert state_sh[RNG_VAR].spec == P()
+    assert out_state[RNG_VAR].spec == P()
+    assert state_sh[moments[0]].spec[0] == "dp"
+    assert state_sh[betas[0]].spec == P()       # scalar: replicated
+    assert state_sh[pinned].spec == P()         # explicit spec wins
+    assert moments[1] not in state_sh
+    assert out_state[moments[1]].spec[0] == "dp"  # divergent out_state
+
+
+def test_zero_spec_fallback_rules(monkeypatch):
+    """Leading-dim divisibility gates the dp shard; the accumulator
+    inherits its parameter's tp spec; PADDLE_TPU_ZERO=0 kills it all."""
+    main, startup, _ = _build_gpt()
+    mesh = _mesh()
+    block = main.global_block()
+    mom = next(n for n in sorted(block.vars) if n.endswith("_moment1")
+               and len(block.vars[n].shape) == 2)
+    var = block.vars[mom]
+    assert papi.zero_spec_for(var, mesh, block)[0] == "dp"
+
+    odd = block.create_var(name="odd_moment", shape=[7, 3],
+                           dtype="float32", persistable=True)
+    odd.zero_param = var.zero_param
+    assert papi.zero_spec_for(odd, mesh, block) is None  # 7 % 8 != 0
+
+    # tp-sharded parameter: the accumulator inherits P(None, 'tp') and
+    # still gains the dp leading shard
+    pvar = block._find_var(var.zero_param)
+    pvar.partition_spec = P(None, "tp")
+    spec = papi.zero_spec_for(var, mesh, block)
+    assert spec == P("dp", "tp")
+    pvar.partition_spec = P("dp", None)  # leading axis taken: no double-dp
+    assert papi.zero_spec_for(var, mesh, block) == P("dp", None)
+
+    monkeypatch.setenv("PADDLE_TPU_ZERO", "0")
+    assert papi.zero_spec_for(var, mesh, block) is None
+    monkeypatch.delenv("PADDLE_TPU_ZERO")
+    assert papi.zero_spec_for(var, None, block) is None  # no mesh
+
+
+def test_optimizer_state_report_static():
+    """Pure-metadata accounting: dp=8 shards the moments ~8x, the lr /
+    beta-pow scalars stay replicated, and the per-device figure clears
+    the replicated/4 acceptance bound without touching any array."""
+    main, startup, _ = _build_gpt()
+    mesh = _mesh()
+    rep = papi.optimizer_state_report(main, mesh)
+    assert rep["sharded_vars"] > 0 and rep["replicated_vars"] >= 3
+    assert rep["per_device_bytes"] * 4 <= rep["total_bytes"]
+    rep1 = papi.optimizer_state_report(main, None)
+    assert rep1["per_device_bytes"] == rep1["total_bytes"]
+
+
+# -- ZeRO-1 bit-exactness ---------------------------------------------------
+def test_zero_bitexact_adam_dp8():
+    """ZeRO-1 sharded Adam state vs the replicated spelling on the SAME
+    dp=8 mesh: loss and updated params bit-exact (the gradient pin at
+    the backward/optimizer boundary isolates the backward from the
+    accumulator shardings), and the live moment arrays really are
+    dp-sharded."""
+    feed = _gpt_feed()
+    mesh = _mesh()
+    lz, pz, _cost, _plan, main, state = _train(
+        lambda: _build_gpt(), feed, "avg_cost", mesh, zero=True)
+    lr, pr, _cost_r, _plan_r, _main_r, _state_r = _train(
+        lambda: _build_gpt(), feed, "avg_cost", mesh, zero=False)
+    for a, b in zip(lz, lr):
+        assert np.array_equal(a, b)
+    for k in pz:
+        assert np.array_equal(pz[k], pr[k]), k
+    mom = next(n for n in sorted(state) if n.endswith("_moment1"))
+    assert "dp" in str(state[mom].sharding.spec)
+    beta = next(n for n in sorted(state) if n.startswith("beta1_pow"))
+    assert state[beta].sharding.spec == P()
+
+
+def test_zero_bitexact_momentum_dp8():
+    feed_rng = np.random.default_rng(11)
+    feed = {"x": feed_rng.normal(size=(BATCH, 16)).astype(np.float32),
+            "y": feed_rng.normal(size=(BATCH, 1)).astype(np.float32)}
+    mesh = _mesh()
+
+    def build():
+        return _build_mlp(lambda: pt.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9))
+
+    lz, pz, _c, _p, _m, state = _train(build, feed, 2, mesh, zero=True)
+    lr, pr, *_ = _train(build, feed, 2, mesh, zero=False)
+    for a, b in zip(lz, lr):
+        assert np.array_equal(a, b)
+    for k in pz:
+        assert np.array_equal(pz[k], pr[k]), k
+    vel = next(n for n in sorted(state) if n.endswith("_velocity"))
+    assert "dp" in str(state[vel].sharding.spec)
+
+
+def test_zero_dp8_matches_dp1():
+    """dp=8 ZeRO training tracks the single-device run (different
+    cross-chip reduction order: close, not bit-identical)."""
+    feed = _gpt_feed()
+    l8, p8, *_ = _train(lambda: _build_gpt(), feed, "avg_cost", _mesh())
+    l1, p1, *_ = _train(lambda: _build_gpt(), feed, "avg_cost", None)
+    np.testing.assert_allclose(
+        np.ravel(l8).astype(np.float64), np.ravel(l1).astype(np.float64),
+        rtol=1e-5, atol=1e-6)
+    for k in p8:
+        np.testing.assert_allclose(p8[k], p1[k], rtol=5e-4, atol=5e-5,
+                                   err_msg=k)
+
+
+# -- comm-aware gradient accumulation ---------------------------------------
+def test_local_accum_one_reduce_per_step():
+    """accum_steps=4 on dp=8: the compiled HLO carries ZERO reduce-class
+    collectives inside loop bodies (each gradient is cross-chip-reduced
+    exactly once per optimizer step, at the boundary) and the static
+    reduce set does not grow with accum."""
+    feed = _gpt_feed()
+    mesh = _mesh()
+    _l, _p, cost4, plan4, _m, _s = _train(
+        lambda: _build_gpt(accum=4), feed, "avg_cost", mesh)
+    assert plan4["mode"] == "local" and plan4["dp"] == 8
+    assert cost4["reduce_ops_in_loop"] == 0
+    assert cost4["reduce_ops"] > 0
+    _l1, _p1, cost1, _plan1, _m1, _s1 = _train(
+        lambda: _build_gpt(accum=1), feed, "avg_cost", mesh)
+    assert cost1["reduce_ops_in_loop"] == 0
+    # one reduction per param per STEP: accum must not multiply the
+    # boundary reduce set (fusion may merge a couple of scalars)
+    assert cost4["reduce_ops"] <= cost1["reduce_ops"] + 2
+
+
+def test_local_accum_matches_dp1():
+    """Comm-aware dp=8 accumulation vs the dp=1 accumulation reference:
+    same equal-weight-mean contract, close numerics (the device-group
+    lanes change float summation order)."""
+    feed = _gpt_feed()
+    l8, p8, _c, plan, _m, _s = _train(
+        lambda: _build_gpt(accum=4), feed, "avg_cost", _mesh())
+    assert plan["mode"] == "local"
+    l1, p1, _c1, plan1, _m1, _s1 = _train(
+        lambda: _build_gpt(accum=4), feed, "avg_cost", None)
+    assert plan1["mode"] == "reduce_each"  # dp=0: reference spelling
+    np.testing.assert_allclose(
+        np.ravel(l8).astype(np.float64), np.ravel(l1).astype(np.float64),
+        rtol=2e-5, atol=2e-6)
+    for k in p8:
+        np.testing.assert_allclose(p8[k], p1[k], rtol=1e-3, atol=1e-4,
+                                   err_msg=k)
+
+
+def test_local_accum_fallback_reasons(monkeypatch):
+    """Ineligible programs fall back to the reference spelling with the
+    reason recorded — never silently."""
+    mesh = _mesh()
+    # stateful rng (dropout) -> vmapped lanes would share one key stream
+    _l, _p, _c, plan, _m, _s = _train(
+        lambda: _build_gpt(accum=4, dropout=0.3), _gpt_feed(), "avg_cost",
+        mesh, steps=1)
+    assert plan["mode"] == "reduce_each"
+    assert "rng" in plan["reason"]
+    # microbatch not divisible by dp
+    _l, _p, _c, plan, _m, _s = _train(
+        lambda: _build_gpt(accum=4), _gpt_feed(batch=16), "avg_cost",
+        mesh, steps=1)
+    assert plan["mode"] == "reduce_each"
+    assert "divisible" in plan["reason"]
+    # kill switch
+    monkeypatch.setenv("PADDLE_TPU_LOCAL_ACCUM", "0")
+    _l, _p, _c, plan, _m, _s = _train(
+        lambda: _build_gpt(accum=4), _gpt_feed(), "avg_cost", mesh,
+        steps=1)
+    assert plan["mode"] == "reduce_each"
+    assert "PADDLE_TPU_LOCAL_ACCUM" in plan["reason"]
+
+
+# -- the comm audit itself --------------------------------------------------
+def test_hlo_comm_report_parser():
+    text = """\
+HloModule jit_step, entry_computation_layout={()->f32[8]{0}}
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  ROOT %r = f32[] add(f32[] %a, f32[] %b)
+}
+
+%wide.body (p: (s32[], f32[64,32])) -> (s32[], f32[64,32]) {
+  %ar.1 = f32[64,32]{1,0} all-reduce(f32[64,32] %x), to_apply=%add
+  %ag.7 = f32[8,4]{1,0} all-gather(f32[1,4] %y), dimensions={0}
+}
+
+%wide.cond (p: (s32[], f32[64,32])) -> pred[] {
+}
+
+ENTRY %main (a: f32[64,32]) -> f32[8] {
+  %w = (s32[], f32[64,32]) while((s32[], f32[64,32]) %t), \
+condition=%wide.cond, body=%wide.body
+  %ar.2 = f32[64,32]{1,0} all-reduce(f32[64,32] %z), to_apply=%add
+  %rs = f32[8,32]{1,0} reduce-scatter(f32[64,32] %z2), dimensions={0}
+  %agd = f32[64,32]{1,0} all-gather-done(f32[8,32] %h)
+}
+"""
+    rep = hlo_comm_report(text)
+    assert rep["collective_ops"] == {
+        "all-reduce": 2, "all-gather": 1, "reduce-scatter": 1}
+    assert rep["reduce_ops"] == 3
+    assert rep["reduce_ops_in_loop"] == 1
+    assert rep["collectives_in_loop"] == 2
+    assert rep["reduce_bytes_in_loop"] == 64 * 32 * 4
+    assert rep["collective_bytes"] == (
+        2 * 64 * 32 * 4 + 8 * 4 * 4 + 8 * 32 * 4)
+
+
+def test_executor_cost_carries_comm_fields():
+    feed = _gpt_feed()
+    _l, _p, cost, _plan, _m, _s = _train(
+        lambda: _build_gpt(), feed, "avg_cost", _mesh(), steps=1)
+    for k in ("collective_count", "collective_bytes",
+              "collective_op_kinds", "reduce_ops", "reduce_bytes",
+              "reduce_ops_in_loop"):
+        assert k in cost, k
+    assert isinstance(cost["collective_op_kinds"], dict)
+    reg = pt.observability.get_registry()
+    assert reg.value("executor.collective_bytes") >= cost[
+        "collective_bytes"]
+
+
+# -- pre-sharded prefetch ---------------------------------------------------
+def test_prefetch_to_device_sharding():
+    mesh = _mesh()
+    sh = NamedSharding(mesh, P("dp"))
+
+    def reader():
+        for i in range(3):
+            yield {"x": np.full((8, 4), i, np.float32),
+                   "aux": np.float32(i)}
+
+    got = list(pt.reader.prefetch_to_device(
+        reader, 2, sharding={"x": sh})())
+    assert len(got) == 3
+    for i, item in enumerate(got):
+        assert item["x"].sharding == sh
+        assert float(item["x"][0, 0]) == i
+        assert isinstance(item["aux"], jax.Array)  # default put
+
+
+def test_trainer_prefetch_lands_sharded_batches():
+    """Trainer(prefetch=N) on a mesh-bound executor threads the feed
+    shardings into prefetch_to_device: the step consumes dp-pre-sharded
+    device arrays (the executor accepts them as-is) and still trains."""
+    mesh = _mesh()
+    main, startup, loss = _build_mlp(
+        lambda: pt.optimizer.SGD(learning_rate=0.05))
+    papi.data_parallel(main, "dp", programs=(startup,))
+    with pt.program_guard(main, startup):
+        trainer = pt.trainer.Trainer(loss, [
+            main.global_block().vars["x"], main.global_block().vars["y"]],
+            mesh=mesh)
+        sh = trainer._feed_shardings()
+        assert sh["x"].spec[0] == "dp" and sh["y"].spec[0] == "dp"
+        rng = np.random.default_rng(0)
+
+        def reader():
+            for _ in range(4):
+                yield [(rng.normal(size=(16,)).astype(np.float32),
+                        rng.normal(size=(1,)).astype(np.float32))
+                       for _ in range(BATCH)]
+
+        costs = []
+        trainer.train(
+            reader, num_passes=1, prefetch=2,
+            event_handler=lambda ev: costs.append(ev.cost)
+            if isinstance(ev, pt.trainer.EndIteration) else None)
+    assert len(costs) == 4 and np.isfinite(costs).all()
+
+
+# -- the scaling benchmark row ----------------------------------------------
+def test_multichip_bench_row():
+    """benchmarks/multichip.py --smoke in-process: one row with the
+    scaling facts, every structural gate green on the CPU mesh."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "multichip.py")
+    spec = importlib.util.spec_from_file_location("_bench_multichip", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    row = mod.run_smoke(devices=8)
+    assert "error" not in row, row
+    for k in ("dp1_step_ms", "dp_step_ms", "scaling_efficiency",
+              "collective_bytes", "reduce_ops", "reduce_ops_in_loop",
+              "opt_state_bytes_per_device", "opt_state_bytes_replicated",
+              "accum_plan"):
+        assert k in row, (k, row)
+    assert not [k for k in row if k.startswith("gate_")], row
+    assert row["reduce_ops_in_loop"] == 0
+    assert row["opt_state_bytes_per_device"] * 4 <= row[
+        "opt_state_bytes_replicated"]
+    assert row["accum_plan"]["mode"] == "local"
+
+
+def test_comm_overlap_flags(monkeypatch):
+    assert papi.comm_overlap_flags("cpu") == ()
+    assert any("latency_hiding" in f
+               for f in papi.comm_overlap_flags("tpu"))
+    monkeypatch.setenv("XLA_FLAGS", "--xla_foo=1")
+    applied = papi.enable_comm_overlap("tpu")
+    assert applied and all(
+        f.split("=")[0] in os.environ["XLA_FLAGS"] for f in applied)
+    assert os.environ["XLA_FLAGS"].startswith("--xla_foo=1")
+    # one flag's key is a PREFIX of another's: a pre-set longer flag must
+    # not swallow the shorter one (keys compare tokenized, not substring)
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=false")
+    papi.enable_comm_overlap("tpu")
+    assert ("--xla_tpu_enable_async_collective_fusion=true"
+            in os.environ["XLA_FLAGS"].split())
+    monkeypatch.setenv("PADDLE_TPU_COMM_OVERLAP", "0")
+    assert papi.enable_comm_overlap("tpu") == ()
+    # cpu platform never touches the env (unknown flags abort XLA init)
+    monkeypatch.setenv("PADDLE_TPU_COMM_OVERLAP", "1")
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert papi.enable_comm_overlap("cpu") == ()
+    assert os.environ["XLA_FLAGS"] == ""

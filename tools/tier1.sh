@@ -39,6 +39,18 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_memtest.log >&2
     exit 1
 fi
+# multichip smoke: the scaling-engine invariants on the 8-device virtual
+# CPU mesh — ZeRO-1 accumulator sharding (state bytes/device <=
+# replicated/4), one cross-chip gradient reduction per optimizer step
+# under accum (comm audit on compiled HLO), and ZeRO bit-exactness vs
+# the replicated spelling (docs/parallel.md)
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --multichip-selftest \
+        > /tmp/_t1_multichip.log 2>&1; then
+    echo "TIER1 REGRESSION: multichip selftest failed" >&2
+    cat /tmp/_t1_multichip.log >&2
+    exit 1
+fi
 # serving smoke: the continuous-batching engine must beat the sequential
 # single-stream baseline (asserted inside --smoke) and print ONE
 # parseable JSON row with the throughput/latency/compile fields
