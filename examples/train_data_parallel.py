@@ -17,6 +17,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def build_program():
+    """The example's training program (with the data-parallel batch
+    annotations but no mesh/devices), built without running — the entry
+    point ``python -m paddle_tpu --lint-selftest`` lints.  Returns
+    (main_program, startup_program, fetch_list)."""
+    import paddle_tpu as pt
+    from paddle_tpu import parallel
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        model = pt.models.resnet.build(depth=20, class_dim=10,
+                                       image_shape=(3, 32, 32),
+                                       learning_rate=0.05,
+                                       dtype="float32")
+    parallel.data_parallel(main_prog, "dp", programs=(startup,))
+    return main_prog, startup, [model["avg_cost"], model["accuracy"]]
+
+
 def main():
     import jax
 

@@ -15,6 +15,16 @@ import numpy as np
 import paddle_tpu as pt
 
 
+def build_program():
+    """The example's training program, built without running — the
+    entry point ``python -m paddle_tpu --lint-selftest`` lints.
+    Returns (main_program, startup_program, fetch_list)."""
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        model = pt.models.lenet.build(learning_rate=0.001)
+    return main_prog, startup, [model["avg_cost"], model["accuracy"]]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--passes", type=int, default=3)

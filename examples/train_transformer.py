@@ -16,6 +16,19 @@ import paddle_tpu as pt
 from paddle_tpu.models import transformer
 
 
+def build_program(vocab=64, seq=64):
+    """The example's training program, built without running — the
+    entry point ``python -m paddle_tpu --lint-selftest`` lints.
+    Returns (main_program, startup_program, fetch_list)."""
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        outs = transformer.build(vocab_size=vocab, n_layer=2, n_head=4,
+                                 d_model=128, max_len=seq,
+                                 dropout_rate=0.0, learning_rate=3e-3,
+                                 dtype="float32")
+    return main_prog, startup, [outs["avg_cost"]]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=200)

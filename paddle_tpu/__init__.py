@@ -58,6 +58,7 @@ from . import data_feeder
 from .data_feeder import DataFeeder
 from . import parallel
 from . import observability
+from . import analysis
 from . import serving
 from . import profiler
 from . import trainer

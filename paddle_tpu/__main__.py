@@ -308,7 +308,7 @@ def cmd_memory_selftest(args=None):
     import numpy as np
 
     import paddle_tpu as pt
-    from paddle_tpu.core.memaudit import audit_program
+    from paddle_tpu.analysis import audit_program
     from paddle_tpu.models import transformer
 
     failures = []
@@ -516,6 +516,295 @@ def cmd_multichip_selftest(args=None):
     return 1 if failures else 0
 
 
+def cmd_lint(argv):
+    """``python -m paddle_tpu --lint <config.py> [--strict] [--json]
+    [--levels program,jaxpr,hlo]``: build a model-config script's
+    Program and run the static-analysis engine over it — program-level
+    IR checks, the traced-jaxpr checks, and the compiled-HLO checks
+    (feeds and parameters are synthesized from declared shapes; no
+    training step executes).  Prints one line per finding plus a
+    summary; rc 1 when error-severity findings survive (rc 2 under
+    --strict, where the AnalysisError message prints instead)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    p = argparse.ArgumentParser(prog="paddle_tpu --lint")
+    p.add_argument("config",
+                   help="model-config script: build() -> dict (the train "
+                        "convention) or build_program() -> (main, "
+                        "startup, fetch_list) (the examples/ convention)")
+    p.add_argument("--strict", action="store_true",
+                   help="raise on error-severity findings (rc 2)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the full report as one JSON object")
+    p.add_argument("--levels", default="program,jaxpr,hlo",
+                   help="comma-separated artifact levels to run")
+    p.add_argument("--hbm-budget", type=int, default=None,
+                   help="device memory budget in bytes for the "
+                        "hlo.hbm-preflight check (defaults to the "
+                        "device's reported limit; CPU reports none, so "
+                        "pass the target chip's HBM to preflight a "
+                        "capacity config off-accelerator)")
+    args = p.parse_args([a for a in argv if a != "--lint"])
+
+    import json as _json
+
+    from paddle_tpu import analysis
+
+    mod = _load_config(args.config)
+    if hasattr(mod, "build"):
+        main_prog, _startup, outs = _build(mod)
+        fetch = [outs["avg_cost"]] if "avg_cost" in outs else []
+        fetch += [v for k, v in outs.items()
+                  if k not in ("feed", "avg_cost") and hasattr(v, "name")]
+    elif hasattr(mod, "build_program"):
+        main_prog, _startup, fetch = mod.build_program()
+    else:
+        raise SystemExit(
+            f"{args.config}: defines neither build() nor "
+            f"build_program(); see python -m paddle_tpu --lint --help")
+    levels = tuple(s.strip() for s in args.levels.split(",") if s.strip())
+    try:
+        report = analysis.lint(main_prog, fetch_list=fetch, levels=levels,
+                               strict=args.strict,
+                               hbm_budget=args.hbm_budget)
+    except analysis.AnalysisError as e:
+        print(e)
+        return 2
+    if args.as_json:
+        print(_json.dumps(report.to_dict()))
+    else:
+        for f in report:
+            print(repr(f))
+            if f.hint:
+                print(f"    hint: {f.hint}")
+        print("lint: " + report.summary())
+    return 0 if report.ok else 1
+
+
+def cmd_lint_selftest(args=None):
+    """``python -m paddle_tpu --lint-selftest``: the static-analysis
+    engine's CI gate, CPU-only — plants one Program per defect class
+    (dead var/op, shape-dtype mismatch, read-before-write, fetch
+    overwrite, bf16 accumulation, tanh-in-scan, scan-locality loss,
+    degraded offload, >HBM-budget temp, in-loop collective on a
+    2-device virtual mesh) and asserts the exact finding ids; asserts
+    ZERO findings on the clean GPT benchmark program under every remat
+    policy; asserts strict mode raises; and lints every ``examples/``
+    script's program.  Wired into tools/tier1.sh."""
+    n = 2
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n or jax.devices()[0].platform != "cpu":
+        # backend already initialized without the virtual mesh: re-exec
+        # clean, ONCE (the multichip-selftest convention)
+        if os.environ.get("_PT_LINT_SELFTEST_CHILD"):
+            print(f"FAIL cannot provision {n} cpu devices "
+                  f"(have {len(jax.devices())} "
+                  f"{jax.devices()[0].platform!r})")
+            return 1
+        import subprocess
+
+        env = dict(os.environ)
+        for k in list(env):
+            if "AXON" in k or k.startswith(("TPU_", "PJRT_")):
+                env.pop(k)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["_PT_LINT_SELFTEST_CHILD"] = "1"
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "--lint-selftest"],
+            env=env, timeout=1800)
+        return proc.returncode
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import analysis, layers
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel import api as papi
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    failures = []
+
+    def check(cond, what):
+        (failures.append(what) if not cond else None)
+        print(("ok   " if cond else "FAIL ") + what)
+
+    # -- planted Program-level defects ---------------------------------
+    pt.core.unique_name.reset()
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.fc(x, 2, name="live")
+        layers.fc(x, 3, name="deadfc")  # dead op chain
+        blk = main_prog.global_block()
+        blk.create_var(name="orphan", shape=(3,), dtype="float32")
+        a = blk.create_var(name="a", shape=(-1, 4), dtype="float32")
+        b = blk.create_var(name="b", shape=(-1, 8), dtype="float32")
+        c = blk.create_var(name="c", shape=(-1, 4), dtype="float32")
+        blk.append_op("elementwise_add", {"X": [a.name], "Y": [b.name]},
+                      {"Out": [c.name]})
+        blk.append_op("relu", {"X": [x.name]}, {"Out": [y.name]})
+    rep = analysis.lint(main_prog, fetch_list=[y], levels=("program",))
+    ids = set(rep.ids())
+    check("program.dead-code" in ids, "planted dead var/op reported")
+    check("program.shape-dtype" in ids,
+          "planted shape mismatch reported")
+    check("program.read-before-write" in ids,
+          "planted read-before-write reported")
+    check("program.fetch-overwritten" in ids,
+          "planted fetch overwrite reported")
+    try:
+        analysis.lint(main_prog, fetch_list=[y], levels=("program",),
+                      strict=True)
+        check(False, "strict mode raises AnalysisError")
+    except analysis.AnalysisError:
+        check(True, "strict mode raises AnalysisError")
+
+    # -- planted jaxpr-level defects -----------------------------------
+    def small_gpt(policy, n_layer=5):
+        pt.core.unique_name.reset()
+        mp, sp = pt.Program(), pt.Program()
+        mp.random_seed = 7
+        with pt.program_guard(mp, sp):
+            outs = transformer.build(
+                vocab_size=29, n_layer=n_layer, n_head=2, d_model=32,
+                max_len=12, dropout_rate=0.0, dtype="float32")
+        if policy:
+            pt.memory_optimize(mp, policy=policy)
+        return mp, outs["avg_cost"]
+
+    mp, loss = small_gpt("selective")
+    os.environ["PADDLE_TPU_SCAN_REMAT"] = "0"
+    try:
+        rep = analysis.lint(mp, fetch_list=[loss], levels=("jaxpr",),
+                            layer_count=5)
+    finally:
+        os.environ.pop("PADDLE_TPU_SCAN_REMAT", None)
+    check("jaxpr.scan-locality" in rep.ids(),
+          "unrolled kernel calls (scan engine off) reported")
+
+    pt.core.unique_name.reset()
+    mp, sp = pt.Program(), pt.Program()
+    with pt.program_guard(mp, sp):
+        xb = layers.data("xb", shape=[16, 8], dtype="bfloat16")
+        init = layers.reduce_mean(xb, dim=1)
+        rnn = layers.StaticRNN(name="acc")
+        with rnn.step():
+            xt = rnn.step_input(xb)
+            acc = rnn.memory(init)
+            new = acc + xt
+            rnn.update_memory(acc, new)
+            rnn.step_output(new)
+        tot = layers.reduce_sum(rnn())
+    rep = analysis.lint(mp, fetch_list=[tot], levels=("jaxpr",))
+    check("jaxpr.bf16-accum" in rep.ids(),
+          "bf16 scan-carry accumulation reported")
+
+    pt.core.unique_name.reset()
+    mp, sp = pt.Program(), pt.Program()
+    with pt.program_guard(mp, sp):
+        xv = layers.data("x", shape=[16])
+        h = xv
+        for i in range(4):
+            h = layers.fc(h, 16, act="tanh", name=f"l{i}")
+        loss2 = layers.reduce_mean(layers.fc(h, 1, name="head"))
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss2)
+    pt.memory_optimize(mp, policy="full")
+    rep = analysis.lint(mp, fetch_list=[loss2], levels=("jaxpr",))
+    check("jaxpr.tanh-gelu" in rep.ids(),
+          "tanh inside scanned remat body reported")
+
+    pt.core.unique_name.reset()
+    mp, sp = pt.Program(), pt.Program()
+    with pt.program_guard(mp, sp):
+        xv = layers.data("x", shape=[16])
+        h = layers.fc(xv, 12, act="relu", name="a1")
+        h = layers.fc(h, 6, act="sigmoid", name="b1")
+        loss3 = layers.reduce_mean(layers.fc(h, 1, name="c1"))
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss3)
+    pt.memory_optimize(mp, policy="offload")
+    rep = analysis.lint(mp, fetch_list=[loss3], levels=("jaxpr",))
+    check("jaxpr.kernel-residual" in rep.ids(),
+          "offload degraded on non-uniform program reported")
+
+    # -- planted HLO-level defects -------------------------------------
+    mp, loss = small_gpt(None)
+    rep = analysis.lint(mp, fetch_list=[loss], levels=("hlo",),
+                        hbm_budget=1)
+    check("hlo.hbm-preflight" in rep.ids()
+          and rep.by_check("hlo.hbm-preflight")[0].severity == "error",
+          ">HBM-budget compiled step reported (static preflight)")
+
+    fs = analysis.donation_findings(
+        {"argument_bytes": 5 << 20, "alias_bytes": 0}, True)
+    check([f.check for f in fs] == ["hlo.donation-alias"]
+          and not analysis.donation_findings(
+              {"argument_bytes": 5 << 20, "alias_bytes": 4 << 20}, True),
+          "donated-buffer aliasing audit")
+
+    pt.core.unique_name.reset()
+    mp, sp = pt.Program(), pt.Program()
+    with pt.program_guard(mp, sp):
+        xv = layers.data("x", shape=[16, 8])
+        init = layers.reduce_mean(xv, dim=[0, 1])
+        rnn = layers.StaticRNN(name="acc")
+        with rnn.step():
+            xt = rnn.step_input(xv)
+            acc = rnn.memory(init)
+            s = layers.reduce_sum(xt, dim=0)
+            new = acc + s
+            rnn.update_memory(acc, new)
+            rnn.step_output(new)
+        tot = layers.reduce_sum(rnn())
+    papi.data_parallel(mp, "dp", programs=(sp,))
+    mesh = make_mesh({"dp": n})
+    rep = analysis.lint(mp, fetch_list=[tot], mesh=mesh, levels=("hlo",))
+    inloop = rep.by_check("hlo.inloop-collective")
+    check(bool(inloop) and inloop[0].severity == "error",
+          "planted in-loop collective reported on the virtual mesh")
+
+    # -- clean program: the GPT benchmark program, zero findings -------
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, 29, (2, 12)).astype(np.int64)
+    feed = {"tokens": toks, "labels": np.roll(toks, -1, axis=1)}
+    for policy in (None, "selective", "offload"):
+        mp, loss = small_gpt(policy)
+        rep = analysis.lint(mp, feed=feed, fetch_list=[loss],
+                            layer_count=5)
+        check(len(rep) == 0,
+              f"clean GPT program (policy={policy}) has zero findings "
+              f"({rep.ids()})")
+
+    # -- every examples/ script lints clean ----------------------------
+    import glob
+
+    ex_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples")
+    scripts = sorted(glob.glob(os.path.join(ex_dir, "*.py")))
+    check(bool(scripts), f"examples/ scripts found ({len(scripts)})")
+    for path in scripts:
+        name = os.path.basename(path)
+        try:
+            mod = _load_config(path)
+            mp, sp, fetch = mod.build_program()
+            rep = analysis.lint(mp, fetch_list=fetch,
+                                levels=("program",))
+            check(len(rep.errors) == 0 and len(rep.warnings) == 0,
+                  f"examples/{name} lints clean ({rep.ids()})")
+        except Exception as e:  # noqa: BLE001
+            check(False, f"examples/{name} lint crashed: "
+                         f"{type(e).__name__}: {e}")
+
+    print("lint selftest " + ("FAILED" if failures else "PASSED"))
+    return 1 if failures else 0
+
+
 def main(argv=None):
     from .flags import init_flags
 
@@ -527,6 +816,10 @@ def main(argv=None):
         return cmd_memory_selftest()
     if "--multichip-selftest" in argv:
         return cmd_multichip_selftest()
+    if "--lint-selftest" in argv:
+        return cmd_lint_selftest()
+    if "--lint" in argv:
+        return cmd_lint(argv)
 
     p = argparse.ArgumentParser(prog="paddle_tpu")
     sub = p.add_subparsers(dest="command", required=True)

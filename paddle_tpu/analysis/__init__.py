@@ -1,0 +1,100 @@
+"""paddle_tpu.analysis — the Program/HLO static-analysis engine.
+
+A lint pass framework over the three artifact levels a training step
+passes through (Program IR -> traced jaxpr -> partitioned/optimized
+HLO), with structured findings and a strict mode that raises.  See
+``docs/analysis.md`` for the check catalog, the severity policy and how
+to register a new check.
+
+    import paddle_tpu as pt
+
+    report = pt.analysis.lint(main_prog, feed, [loss])
+    for f in report:
+        print(f)                  # [error] hlo.hbm-preflight @ ...
+    report.raise_for_errors()     # or lint(..., strict=True)
+
+CLI: ``python -m paddle_tpu --lint <config.py>`` and
+``python -m paddle_tpu --lint-selftest`` (wired into tools/tier1.sh).
+The Executor also folds the program- and hlo-level findings of every
+compile into ``exe.last_step_cost`` (``lint_findings`` /
+``lint_errors`` / ``lint_checks``; kill switch ``PADDLE_TPU_LINT=0``)
+and the trainer JSONL.
+"""
+
+from .framework import (
+    SEVERITIES,
+    LEVELS,
+    Finding,
+    AnalysisError,
+    AnalysisReport,
+    ArtifactError,
+    CheckContext,
+    register_check,
+    registered_checks,
+    lint,
+    compile_findings,
+    preflight_hbm,
+    lint_enabled,
+)
+
+# importing the check modules registers the seeded checks
+from . import program_checks  # noqa: F401
+from . import jaxpr_checks  # noqa: F401
+from . import hlo_checks  # noqa: F401
+from .hlo_checks import donation_findings
+from .jaxpr_tools import (
+    KERNEL_RESIDUAL_TAG,
+    BLOCK_INPUT_TAG,
+    jaxpr_report,
+    walk_report,
+)
+from .hlo_tools import (
+    REDUCE_COLLECTIVES,
+    hlo_comm_report,
+    comm_report,
+    compiled_memory_stats,
+    shape_pattern,
+)
+
+__all__ = [
+    "SEVERITIES", "LEVELS", "Finding", "AnalysisError", "AnalysisReport",
+    "ArtifactError", "CheckContext", "register_check", "registered_checks",
+    "lint", "compile_findings", "preflight_hbm", "lint_enabled",
+    "donation_findings",
+    "KERNEL_RESIDUAL_TAG", "BLOCK_INPUT_TAG", "jaxpr_report",
+    "walk_report", "REDUCE_COLLECTIVES", "hlo_comm_report", "comm_report",
+    "compiled_memory_stats", "shape_pattern",
+    "audit_program",
+]
+
+
+def audit_program(program, feed, fetch_list, scope=None, layer_count=None,
+                  compile_stats=True, absent_shapes=()):
+    """Lower ``program`` through a fresh Executor, trace the full step
+    (forward+backward+optimizer) and return ``jaxpr_report`` extended
+    with compile-time memory figures — the PR 4 audit entry point, now
+    running on the pass framework's artifact context.
+
+    ``absent_shapes``: iterable of shape tuples that must NOT appear in
+    the optimized HLO text (e.g. ``(num_layers, t, d_model)`` — the
+    BENCH_r05 failure shape); hit counts land in
+    ``report["absent_shape_hits"]``.
+
+    The scope must already hold the program's parameters (run the
+    startup program into it first).  CPU-safe: used by the tier-1
+    regression test and ``python -m paddle_tpu --memory-selftest``.
+    """
+    ctx = CheckContext(program, feed=feed, fetch_list=fetch_list,
+                       scope=scope, layer_count=layer_count,
+                       donate=False)
+    report = jaxpr_report(ctx.jaxpr, layer_count=layer_count)
+    report["scan_remat_plan"] = list(ctx.remat_plan)
+    if compile_stats:
+        report.update(ctx.memstats)
+        if absent_shapes:
+            text = ctx.hlo_text
+            report["absent_shape_hits"] = {
+                tuple(s): len(shape_pattern(s).findall(text))
+                for s in absent_shapes
+            }
+    return report

@@ -1,0 +1,173 @@
+"""Traced-jaxpr-level checks: the post-autodiff step as jax will compile
+it — scan structure, kernel calls, checkpoint names, dtypes.  All run
+off one shared walk (``ctx.walk``); none executes anything."""
+
+from .framework import register_check
+from .jaxpr_tools import BLOCK_INPUT_TAG, KERNEL_RESIDUAL_TAG
+
+# up to one layer's worth of kernel calls (fwd + dq + dkv = 3) may
+# legitimately sit outside the layer scan when a policy's segmentation
+# leaves the first layer out of the uniform group; the failure mode is
+# O(L) unrolled calls (the BENCH_r05 shape), not O(1)
+PALLAS_OUTSIDE_SCAN_TOLERANCE = 3
+
+
+def _has_remat(program):
+    return bool(getattr(program, "_remat_segments", None))
+
+
+@register_check("jaxpr.scan-locality", level="jaxpr")
+def scan_locality(ctx):
+    """The BENCH_r05 invariant (migrated from
+    ``memaudit.jaxpr_report``): under a ``memory_optimize`` policy every
+    flash ``pallas_call`` must sit INSIDE a ``lax.scan`` body, and no
+    pallas operand/result may carry a leading layer-count axis — the
+    stacked/hoisted form means the per-layer kernel calls escaped the
+    loop and their residuals coexist across the whole layer stack."""
+    if not _has_remat(ctx.program):
+        return []  # no remat policy marked: unrolled kernels are the
+        # program's declared (memory-unoptimized) shape, not a defect
+    rep = ctx.walk
+    findings = []
+    if rep["layer_stacked_pallas"]:
+        findings.append(ctx.finding(
+            "jaxpr.scan-locality", "error", "jaxpr", "pallas_call",
+            f"pallas operand/result carries a leading layer-count axis "
+            f"{rep['layer_stacked_pallas'][:2]} — per-layer kernel "
+            f"calls were stacked/hoisted out of the layer scan (the "
+            f"BENCH_r05 OOM shape)",
+            hint="the scan-remat engine must own the layer loop: check "
+                 "exe.last_remat_plan for fallbacks and run with "
+                 "PADDLE_TPU_SCAN_REMAT=strict to fail loudly",
+            data={"layer_stacked": rep["layer_stacked_pallas"][:8]}))
+    if (rep["pallas_total"] > 0
+            and rep["pallas_outside_scan"]
+            > PALLAS_OUTSIDE_SCAN_TOLERANCE):
+        findings.append(ctx.finding(
+            "jaxpr.scan-locality", "error", "jaxpr", "pallas_call",
+            f"{rep['pallas_outside_scan']} of {rep['pallas_total']} "
+            f"kernel calls sit outside any scan body — the backward is "
+            f"unrolled per layer and its remat temps coexist",
+            hint="the uniform layer group fell out of the scan engine "
+                 "(PADDLE_TPU_SCAN_REMAT disabled, or classification "
+                 "failed — see exe.last_remat_plan for the reason)",
+            data={"outside": rep["pallas_outside_scan"],
+                  "total": rep["pallas_total"]}))
+    return findings
+
+
+@register_check("jaxpr.kernel-residual", level="jaxpr")
+def kernel_residual(ctx):
+    """The kernel-residual / offload contract: under
+    ``memory_optimize(policy='offload')`` the traced step must carry the
+    checkpoint-name tags the name-policy reads (``pt_blk_in`` on the
+    per-layer block inputs; ``pt_kernel_res`` inside custom-VJP kernels)
+    — a missing tag means the policy silently degraded to plain
+    selective and the HBM saving never happens.  Scan-remat fallbacks
+    (groups that fell back to the barrier spelling) are surfaced here
+    too: a silent fallback at a capacity config is a runtime OOM waiting
+    to happen."""
+    findings = []
+    for g in ctx.remat_plan:
+        if "fallback" in g:
+            findings.append(ctx.finding(
+                "jaxpr.kernel-residual", "warning", "jaxpr",
+                f"segment group @ {g.get('start')}",
+                f"scan-remat group (period {g.get('period')} x "
+                f"{g.get('count')}) fell back to the barrier spelling: "
+                f"{g['fallback']}",
+                hint="run with PADDLE_TPU_SCAN_REMAT=strict at capacity "
+                     "configs so the fallback raises instead of OOMing "
+                     "at runtime",
+                data=dict(g)))
+    program = ctx.program
+    if not getattr(program, "_offload", False):
+        return findings
+    from ..core.executor import _offload_mode
+
+    mode = _offload_mode(program)
+    if mode == "off":
+        return findings
+    rep = ctx.walk
+    tags = rep["name_tags"]
+    if BLOCK_INPUT_TAG not in tags:
+        findings.append(ctx.finding(
+            "jaxpr.kernel-residual", "warning", "jaxpr",
+            "checkpoint names",
+            f"offload policy requested (mode {mode!r}) but no "
+            f"{BLOCK_INPUT_TAG!r} tag appears in the traced step — no "
+            f"block-input residual will leave device memory (policy "
+            f"degraded to selective)",
+            hint="offload only engages inside scanned uniform groups; "
+                 "check exe.last_remat_plan — a non-uniform program "
+                 "cannot offload",
+            data={"offload_mode": mode, "tags": sorted(tags)}))
+    if rep["pallas_total"] > 0 and KERNEL_RESIDUAL_TAG not in tags:
+        findings.append(ctx.finding(
+            "jaxpr.kernel-residual", "warning", "jaxpr",
+            "checkpoint names",
+            f"kernel calls present but no {KERNEL_RESIDUAL_TAG!r} tag — "
+            f"a name-policy checkpoint would re-run the kernels in the "
+            f"backward instead of keeping their residuals",
+            hint="kernels' fwd rules must checkpoint_name their "
+                 "residuals (ops/pallas_attention.py contract)",
+            data={"tags": sorted(tags)}))
+    return findings
+
+
+@register_check("jaxpr.bf16-accum", level="jaxpr")
+def bf16_accum(ctx):
+    """Reduced-precision accumulation lint: an ``acc = acc + delta``
+    scan carry held in bf16/f16, or a ``reduce_sum`` folding thousands
+    of bf16 terms into a bf16 result, drops low bits as the running sum
+    outgrows the terms — gradients and metrics accumulated this way
+    drift silently.  The framework's own accumulators (gradient
+    accumulation, Adam moments) carry f32 and never fire this."""
+    rep = ctx.walk
+    findings = []
+    for c in rep["low_precision_carries"]:
+        findings.append(ctx.finding(
+            "jaxpr.bf16-accum", "warning", "jaxpr",
+            f"scan carry {c['carry_index']}",
+            f"scan (length {c['scan_length']}) accumulates into a "
+            f"{c['dtype']} carry of shape {list(c['shape'])} — "
+            f"precision loss grows with the scan length",
+            hint="carry the accumulator in float32 and cast once at the "
+                 "boundary (the gradient-accumulation engine's own "
+                 "spelling)",
+            data=c))
+    for r in rep["low_precision_reduces"]:
+        findings.append(ctx.finding(
+            "jaxpr.bf16-accum", "warning", "jaxpr", "reduce_sum",
+            f"reduce_sum folds {r['folded_elems']} {r['dtype']} "
+            f"elements per output element in {r['dtype']} (operand "
+            f"shape {list(r['shape'])})",
+            hint="cast to float32 before the reduction (or use an f32 "
+                 "preferred_element_type accumulator)",
+            data=r))
+    return findings
+
+
+@register_check("jaxpr.tanh-gelu", level="jaxpr")
+def tanh_gelu(ctx):
+    """The tanh-approximation reassociation hazard: tanh-based
+    activations (tanh-gelu above all) inside a scanned remat body are
+    not reassociation-stable between unrolled and ``lax.scan`` execution
+    on XLA — recompute drifts from the forward at the 1e-3 level, which
+    breaks the scan-remat engine's bit-exactness contract (the reason
+    PR 3 moved gelu to the exact erf form)."""
+    if not _has_remat(ctx.program):
+        return []
+    rep = ctx.walk
+    if not rep["tanh_in_scan"]:
+        return []
+    return [ctx.finding(
+        "jaxpr.tanh-gelu", "warning", "jaxpr", "scan body",
+        f"{rep['tanh_in_scan']} tanh op(s) inside scan bodies of a "
+        f"remat-marked program — tanh's backward is not "
+        f"reassociation-stable under scan, so recompute can drift from "
+        f"the saved forward",
+        hint="use the exact erf gelu (jax.nn.gelu(approximate=False) — "
+             "this framework's 'gelu' op) or keep tanh segments "
+             "unwrapped (saved, not rematerialized)",
+        data={"tanh_in_scan": rep["tanh_in_scan"]})]

@@ -342,14 +342,20 @@ class Executor:
         # analogue of last_remat_plan.  None when the step has no accum.
         self.last_accum_plan = None
 
-    def _aot_compile(self, jitted, args, label):
+    def _aot_compile(self, jitted, args, label, program=None,
+                     fetch_names=()):
         """Explicit ``lower().compile()`` instead of first-call jit, so
         compile time and the executable's static cost model are
         observable: increments ``executor.compile_count``, observes
         ``executor.compile_seconds``, and extracts flops/bytes from
         ``compiled.cost_analysis()`` (the reference has no analog — its
         interpreter never compiles; here the cost model is what turns
-        step wall-time into achieved MFU).  Returns ``(fn, cost)``."""
+        step wall-time into achieved MFU).  When ``program`` is given,
+        the static-analysis engine's program- and hlo-level checks run
+        over the compile artifacts (no extra trace/compile) and their
+        findings summarize into the cost dict (``lint_findings`` /
+        ``lint_errors`` / ``lint_checks`` — PADDLE_TPU_LINT=0 disables).
+        Returns ``(fn, cost)``."""
         reg = _obs.get_registry()
         t0 = time.perf_counter()
         compiled = jitted.lower(*args).compile()
@@ -371,7 +377,7 @@ class Executor:
                 cost["bytes_accessed"] = float(b) if b else None
         except Exception:
             pass  # some backends/plugins don't implement cost analysis
-        from .memaudit import compiled_memory_stats
+        from ..analysis import compiled_memory_stats
 
         memstats = compiled_memory_stats(compiled)
         if memstats:
@@ -396,14 +402,15 @@ class Executor:
                 "executor.hbm_high_water_bytes",
                 help="compiled-step HBM high-water (memory_analysis)",
             ).set_max(high)
+        comm = None
         if self.mesh is not None:
-            # cross-chip communication accounting (memaudit.comm_report):
+            # cross-chip communication accounting (analysis.comm_report):
             # static collective op counts/bytes of the compiled step, with
             # the load-bearing loop split — a reduce op inside a while
             # body pays once per microbatch, one outside pays once per
             # step.  Lands in last_step_cost (bench/trainer JSON channel)
             # and the registry, mirroring the hbm_high_water plumbing.
-            from .memaudit import comm_report
+            from ..analysis import comm_report
 
             comm = comm_report(compiled)
             if comm:
@@ -438,6 +445,29 @@ class Executor:
                 ).set_max(comm["collective_bytes"])
         if self.last_accum_plan is not None:
             cost["accum_comm"] = dict(self.last_accum_plan)
+        from ..analysis import compile_findings, lint_enabled
+
+        if program is not None and lint_enabled():
+            # fold the static-analysis findings of this compile into the
+            # cost dict (and thence the trainer JSONL): program-level
+            # checks over the IR, hlo-level checks over the artifacts
+            # computed above.  run_steps fuses N optimizer steps into ONE
+            # while loop, so in-loop collectives are expected there.
+            try:
+                findings = compile_findings(
+                    program=program, fetch_names=fetch_names,
+                    compiled=compiled, memstats=memstats or None,
+                    comm=comm if self.mesh is not None else {},
+                    in_loop_expected=label.startswith("scan"),
+                    donate=self.donate_state)
+            except Exception:  # noqa: BLE001 — lint must never block a run
+                findings = []
+            cost["lint_findings"] = len(findings)
+            cost["lint_errors"] = sum(
+                1 for f in findings if f.severity == "error")
+            if findings:
+                cost["lint_checks"] = sorted(
+                    {f.check for f in findings})[:8]
         return compiled, cost
 
     # ------------------------------------------------------------------
@@ -555,7 +585,8 @@ class Executor:
             program, feed_names, fetch_names, state_names)
         entry = self._aot_compile(
             jitted, (state,) + tuple(feed_vals),
-            f"run:{program._serial}v{program._version}")
+            f"run:{program._serial}v{program._version}",
+            program=program, fetch_names=tuple(fetch_names))
         self._cache[key] = entry
         return entry, False
 
@@ -653,7 +684,8 @@ class Executor:
             )
             entry = self._aot_compile(
                 jitted, (state,) + tuple(feed_vals),
-                f"scan{steps}:{program._serial}v{program._version}")
+                f"scan{steps}:{program._serial}v{program._version}",
+                program=program, fetch_names=tuple(fetch_names))
             self._cache[key] = entry
         else:
             reg.counter("executor.cache_hits").inc()

@@ -18,27 +18,34 @@ barrier-serialized segments.
 import copy
 
 
+def sub_block_names(program, block_idx, seen=None):
+    """``(reads, writes)`` of every op anywhere under a sub-block,
+    recursing into nested control-flow ops (while containing scan_block,
+    etc.).  The single traversal the pruner and the static-analysis
+    engine's program-level checks both rely on — one definition of "what
+    a control-flow op touches"."""
+    seen = set() if seen is None else seen
+    if block_idx in seen:
+        return set(), set()
+    seen.add(block_idx)
+    reads, writes = set(), set()
+    for op in program.block(block_idx).ops:
+        reads |= set(op.input_names())
+        writes |= set(op.output_names())
+        nested = op.attrs.get("sub_block")
+        if nested is not None:
+            r, w = sub_block_names(program, nested, seen)
+            reads |= r
+            writes |= w
+    return reads, writes
+
+
 def prune_program(program, targets):
     """Return a deep-copied program whose global block keeps only ops needed
     (transitively) to compute ``targets`` (Variables or names)."""
     target_names = {t.name if hasattr(t, "name") else str(t) for t in targets}
     pruned = copy.deepcopy(program)
     block = pruned.global_block()
-
-    def sub_block_reads(block_idx, seen=None):
-        """All names read anywhere under a sub-block, recursing into nested
-        control-flow ops (while containing scan_block, etc.)."""
-        seen = seen if seen is not None else set()
-        if block_idx in seen:
-            return set()
-        seen.add(block_idx)
-        reads = set()
-        for sop in pruned.block(block_idx).ops:
-            reads |= set(sop.input_names())
-            nested = sop.attrs.get("sub_block")
-            if nested is not None:
-                reads |= sub_block_reads(nested, seen)
-        return reads
 
     needed = set(target_names)
     kept = []
@@ -50,7 +57,7 @@ def prune_program(program, targets):
             # control-flow ops pull in their (possibly nested) sub-block reads
             sub_idx = op.attrs.get("sub_block")
             if sub_idx is not None:
-                needed |= sub_block_reads(sub_idx)
+                needed |= sub_block_names(pruned, sub_idx)[0]
     kept.reverse()
     block.ops = kept
     block.backward_index = None

@@ -1,36 +1,32 @@
-"""Backward-pass memory audit: prove scan locality at the jaxpr/HLO level.
+"""DEPRECATED shim — the memory/comm audits moved to
+``paddle_tpu.analysis`` (the static-analysis pass framework).
 
-The BENCH_r05 failure mode was invisible to the compile-succeeds check:
-every ``memory_optimize`` policy *compiled* at t=16k, but the flagship
-step died at runtime with ~20 per-layer ``bf16[6,16384,768]`` HLO temps
-coexisting at the flash-attention backward ``pallas_call``s — per-layer
-backward residuals alive across the whole layer stack instead of one
-layer at a time.  This module makes that property checkable without an
-accelerator:
+PR 4 built the scan-locality audit here and PR 5 added the cross-chip
+comm audit; the analysis engine (``paddle_tpu/analysis/``) generalized
+both into registered lint checks over three artifact levels.  Every
+public name this module used to export keeps working:
 
-* ``jaxpr_report`` walks the step's jaxpr and reports every
-  ``pallas_call`` with its scan-nesting depth — the locality invariant is
-  "every flash call sits INSIDE a ``lax.scan`` body and none of its
-  operands/results carries a leading layer-count axis" (a ``[L, t, d]``
-  pallas operand means the per-layer kernel calls were stacked/hoisted
-  out of the loop, exactly the r05 shape);
-* ``audit_program`` lowers a Program through the Executor, builds the
-  report, and (CPU included — ``CompiledMemoryStats`` works on every
-  backend) attaches ``temp_bytes`` / ``hbm_high_water_bytes`` from
-  ``compiled.memory_analysis()`` plus optimized-HLO shape probes.
+* ``KERNEL_RESIDUAL_TAG`` / ``BLOCK_INPUT_TAG``  -> ``analysis.jaxpr_tools``
+* ``jaxpr_report``                               -> ``analysis.jaxpr_tools``
+* ``hlo_comm_report`` / ``comm_report``          -> ``analysis.hlo_tools``
+* ``compiled_memory_stats``                      -> ``analysis.hlo_tools``
+* ``audit_program``                              -> ``analysis.audit_program``
+* ``REDUCE_COLLECTIVES``                         -> ``analysis.hlo_tools``
 
-The checkpoint-name tags shared by the kernels (``ops/pallas_attention``,
-``ops/pallas_ce``) and the Executor's offload scan body live here:
-under ``memory_optimize(policy="offload")`` each wrapped sub-segment's
-``jax.checkpoint`` carries a name policy that streams ``BLOCK_INPUT_TAG``
-values (the per-layer residual-stream inputs) to pinned host memory and
-keeps ``KERNEL_RESIDUAL_TAG`` values (custom-VJP kernel residuals) in
-device memory.
+New code should import from ``paddle_tpu.analysis`` directly; these
+wrappers emit a ``DeprecationWarning`` once per function and delegate.
 """
 
-import re
+import functools
+import warnings
 
-import numpy as np
+from ..analysis.jaxpr_tools import (  # noqa: F401 — compat re-exports
+    BLOCK_INPUT_TAG,
+    KERNEL_RESIDUAL_TAG,
+)
+from ..analysis.hlo_tools import REDUCE_COLLECTIVES  # noqa: F401
+from ..analysis import jaxpr_tools as _jaxpr_tools
+from ..analysis import hlo_tools as _hlo_tools
 
 __all__ = [
     "KERNEL_RESIDUAL_TAG", "BLOCK_INPUT_TAG",
@@ -38,343 +34,44 @@ __all__ = [
     "hlo_comm_report", "comm_report", "REDUCE_COLLECTIVES",
 ]
 
-# Residuals a custom-VJP kernel saves for its own backward (the flash
-# contract is exactly (q, k, v, o, lse); the fused CE head's is its lse).
-# Tagged INSIDE the kernels' fwd rules so a name-policy checkpoint keeps
-# them instead of re-running the kernel in the backward pass.
-KERNEL_RESIDUAL_TAG = "pt_kernel_res"
-
-# The per-layer block input (the residual stream entering each scanned
-# layer) — the one stacked [L, b, t, d] residual the offload policy
-# moves to pinned host memory on the forward scan and prefetches back
-# during the backward scan.
-BLOCK_INPUT_TAG = "pt_blk_in"
+_warned = set()
 
 
-def _jaxpr_types():
-    """(ClosedJaxpr, Jaxpr) from the supported ``jax.extend.core``
-    location, falling back to the legacy ``jax.core`` aliases on older
-    releases."""
-    try:
-        from jax.extend import core as _jex_core
+def _shim(name, target_name, fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if name not in _warned:
+            _warned.add(name)
+            warnings.warn(
+                f"paddle_tpu.core.memaudit.{name} is deprecated; use "
+                f"paddle_tpu.analysis.{target_name}",
+                DeprecationWarning, stacklevel=2)
+        return fn(*args, **kwargs)
 
-        return _jex_core.ClosedJaxpr, _jex_core.Jaxpr
-    except (ImportError, AttributeError):
-        import jax
-
-        return jax.core.ClosedJaxpr, jax.core.Jaxpr
-
-
-def _sub_jaxprs(eqn):
-    closed_t, jaxpr_t = _jaxpr_types()
-    for v in eqn.params.values():
-        vs = v if isinstance(v, (tuple, list)) else (v,)
-        for x in vs:
-            if isinstance(x, closed_t):
-                yield x.jaxpr
-            elif isinstance(x, jaxpr_t):
-                yield x
+    return wrapper
 
 
-def _aval_bytes(aval):
-    try:
-        return int(np.prod(aval.shape) * np.dtype(aval.dtype).itemsize)
-    except Exception:
-        return 0
-
-
-def jaxpr_report(jaxpr, layer_count=None):
-    """Walk a (Closed)Jaxpr and report kernel-call scan locality.
-
-    Returns a dict:
-
-    * ``pallas_calls``: one entry per ``pallas_call`` eqn —
-      ``{"scan_depth", "shapes"}`` (operand+result shapes);
-    * ``pallas_total`` / ``pallas_outside_scan``: counts (a backward
-      whose flash calls were unrolled per layer shows up here as
-      ``pallas_outside_scan > 0`` and ``pallas_total`` scaling with L);
-    * ``scan_lengths``: the ``length`` of every scan eqn;
-    * ``layer_stacked_pallas``: pallas operand/result shapes whose
-      LEADING dim equals ``layer_count`` — the hoisted-out-of-the-loop
-      form that exhausted HBM in BENCH_r05 (must be empty);
-    * ``residual_stacks``: outputs of layer-count-length scans with a
-      leading ``layer_count`` axis (the EXPECTED per-layer saved
-      residuals), largest first, as ``{"shape", "dtype", "bytes"}``.
-    """
-    closed_t, _ = _jaxpr_types()
-    if isinstance(jaxpr, closed_t):
-        jaxpr = jaxpr.jaxpr
-    report = {
-        "pallas_calls": [],
-        "pallas_total": 0,
-        "pallas_outside_scan": 0,
-        "scan_lengths": [],
-        "layer_stacked_pallas": [],
-        "residual_stacks": [],
-    }
-
-    def walk(jx, depth):
-        for eqn in jx.eqns:
-            name = eqn.primitive.name
-            if name == "pallas_call":
-                shapes = [tuple(v.aval.shape)
-                          for v in list(eqn.invars) + list(eqn.outvars)
-                          if hasattr(v, "aval")
-                          and hasattr(v.aval, "shape")]
-                report["pallas_calls"].append(
-                    {"scan_depth": depth, "shapes": shapes})
-                report["pallas_total"] += 1
-                if depth == 0:
-                    report["pallas_outside_scan"] += 1
-                if layer_count:
-                    report["layer_stacked_pallas"] += [
-                        s for s in shapes
-                        if len(s) >= 2 and s[0] == layer_count]
-            if name == "scan":
-                length = eqn.params.get("length")
-                report["scan_lengths"].append(length)
-                if layer_count and length == layer_count:
-                    for v in eqn.outvars:
-                        aval = getattr(v, "aval", None)
-                        shape = getattr(aval, "shape", ())
-                        if len(shape) >= 1 and shape[0] == layer_count:
-                            report["residual_stacks"].append({
-                                "shape": tuple(shape),
-                                "dtype": str(aval.dtype),
-                                "bytes": _aval_bytes(aval),
-                            })
-            next_depth = depth + (1 if name in ("scan", "while") else 0)
-            for sub in _sub_jaxprs(eqn):
-                walk(sub, next_depth)
-
-    walk(jaxpr, 0)
-    report["residual_stacks"].sort(key=lambda r: -r["bytes"])
-    return report
-
-
-def compiled_memory_stats(compiled):
-    """``compiled.memory_analysis()`` flattened into the fields the rest
-    of the stack reports: ``temp_bytes``, ``argument_bytes``,
-    ``output_bytes``, and ``hbm_high_water_bytes`` (XLA's own
-    liveness-aware peak when the backend reports one, else
-    argument+output+temp minus donation aliasing).  ``{}`` when the
-    backend has no memory analysis."""
-    try:
-        mem = compiled.memory_analysis()
-    except Exception:
-        return {}
-    if mem is None:
-        return {}
-    temp = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
-    arg = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
-    out = int(getattr(mem, "output_size_in_bytes", 0) or 0)
-    alias = int(getattr(mem, "alias_size_in_bytes", 0) or 0)
-    peak = int(getattr(mem, "peak_memory_in_bytes", 0) or 0)
-    high = peak if peak else max(0, arg + out + temp - alias)
-    return {
-        "temp_bytes": temp,
-        "argument_bytes": arg,
-        "output_bytes": out,
-        "hbm_high_water_bytes": high,
-    }
-
-
-def _shape_pattern(shape):
-    return re.compile(r"\[" + ",".join(str(int(s)) for s in shape) + r"\]")
-
-
-# ---------------------------------------------------------------------------
-# Cross-chip communication audit: the comm analogue of the scan-locality
-# walk above.  GSPMD *inserts* the collectives at compile time, so the
-# jaxpr never shows them — the only place the "one gradient reduction per
-# optimizer step" invariant is checkable is the partitioned optimized HLO.
-# The load-bearing classification is LOOP MEMBERSHIP: a reduce op inside a
-# while body executes once per loop iteration (the per-microbatch
-# gradient all-reduce of a naive accumulation loop), one at top level
-# executes once per step.  Static op counts alone cannot tell the two
-# apart.
-
-# collectives that REDUCE across chips (gradient aggregation); gathers /
-# permutes move activations and are reported separately
-REDUCE_COLLECTIVES = ("all-reduce", "reduce-scatter")
-_GATHER_COLLECTIVES = ("all-gather", "collective-permute", "all-to-all",
-                       "collective-broadcast")
-_ALL_COLLECTIVES = REDUCE_COLLECTIVES + _GATHER_COLLECTIVES
-
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
-    "c64": 8, "c128": 16,
-}
-
-_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%([\w.\-]+)\s*\(")
-_CALL_RE = re.compile(
-    r"(?:body|condition|to_apply|calls)=%?([\w.\-]+)")
-_BRANCH_RE = re.compile(r"branch_computations=\{([^}]*)\}")
-# lhs shapes may be a tuple — async ``-start`` forms return
-# ``(operand..., result...)`` — so the shape-list class admits parens
-_COLL_RE = re.compile(
-    r"=\s*(\(?[\w\[\]{},:*/() ]*?)\s*"
-    r"\b(" + "|".join(_ALL_COLLECTIVES) + r")((?:-start)?)[.\d]*\(")
-_SHAPE_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
-
-
-def _shape_bytes_list(text):
-    sizes = []
-    for dtype, dims in _SHAPE_RE.findall(text):
-        if dtype not in _DTYPE_BYTES:
-            continue  # token[] etc.
-        numel = 1
-        for d in dims.split(","):
-            if d:
-                numel *= int(d)
-        sizes.append(numel * _DTYPE_BYTES[dtype])
-    return sizes
-
-
-def _collective_bytes(shape_text, is_start):
-    """Output bytes of one collective.  Async ``-start`` forms return an
-    ``(operands..., results...)`` tuple — counting the whole tuple would
-    double the figure the moment latency hiding rewrites the op, so take
-    the result half (the last shape when the split is uneven, e.g.
-    all-gather-start's small operand / big result)."""
-    sizes = _shape_bytes_list(shape_text)
-    if is_start and len(sizes) > 1:
-        if len(sizes) % 2 == 0:
-            return sum(sizes[len(sizes) // 2:])
-        return sizes[-1]
-    return sum(sizes)
-
-
-def hlo_comm_report(text):
-    """Parse optimized (post-SPMD) HLO text and report every cross-chip
-    collective: static counts and output bytes per kind, split by whether
-    the op sits inside a while-loop body (directly, or in a computation a
-    loop body calls).  Keys:
-
-    * ``collective_ops``: ``{kind: count}`` (async ``-start`` forms count
-      once — and contribute their RESULT bytes only, not the whole
-      operand+result tuple — ``-done`` not at all);
-    * ``collective_count`` / ``collective_bytes``: totals;
-    * ``reduce_ops`` / ``reduce_bytes``: the REDUCE class (all-reduce +
-      reduce-scatter) — gradient aggregation;
-    * ``reduce_ops_in_loop`` / ``reduce_bytes_in_loop``: reduce ops that
-      execute once per loop iteration.  The comm-aware accumulation
-      invariant is exactly ``reduce_ops_in_loop == 0``: every gradient is
-      cross-chip-reduced once per optimizer step, at the boundary;
-    * ``collectives_in_loop`` / ``collective_bytes_in_loop``: all kinds
-      (attention-internal gathers land here — reported, not gated).
-    """
-    bodies = set(re.findall(r"body=%?([\w.\-]+)", text))
-    bodies |= set(re.findall(r"condition=%?([\w.\-]+)", text))
-
-    # one-level call graph so a collective inside a computation CALLED
-    # from a while body still counts as in-loop
-    edges = {}
-    cur = None
-    colls = []  # (kind, bytes, computation)
-    for line in text.splitlines():
-        m = _COMP_RE.match(line)
-        if m:
-            cur = m.group(1)
-        head = line.split(" metadata=", 1)[0]
-        for ref in _CALL_RE.findall(head):
-            edges.setdefault(cur, set()).add(ref)
-        for grp in _BRANCH_RE.findall(head):
-            for ref in grp.split(","):
-                edges.setdefault(cur, set()).add(
-                    ref.strip().lstrip("%"))
-        cm = _COLL_RE.search(head)
-        if cm:
-            colls.append((cm.group(2),
-                          _collective_bytes(cm.group(1),
-                                            bool(cm.group(3))),
-                          cur))
-
-    in_loop = set()
-    frontier = list(bodies)
-    while frontier:
-        c = frontier.pop()
-        if c in in_loop:
-            continue
-        in_loop.add(c)
-        frontier.extend(edges.get(c, ()))
-
-    report = {
-        "collective_ops": {},
-        "collective_count": 0, "collective_bytes": 0,
-        "reduce_ops": 0, "reduce_bytes": 0,
-        "reduce_ops_in_loop": 0, "reduce_bytes_in_loop": 0,
-        "collectives_in_loop": 0, "collective_bytes_in_loop": 0,
-    }
-    for kind, nbytes, comp in colls:
-        report["collective_ops"][kind] = (
-            report["collective_ops"].get(kind, 0) + 1)
-        report["collective_count"] += 1
-        report["collective_bytes"] += nbytes
-        looped = comp in in_loop
-        if looped:
-            report["collectives_in_loop"] += 1
-            report["collective_bytes_in_loop"] += nbytes
-        if kind in REDUCE_COLLECTIVES:
-            report["reduce_ops"] += 1
-            report["reduce_bytes"] += nbytes
-            if looped:
-                report["reduce_ops_in_loop"] += 1
-                report["reduce_bytes_in_loop"] += nbytes
-    return report
-
-
-def comm_report(compiled):
-    """``hlo_comm_report`` over a compiled executable's optimized HLO;
-    ``{}`` when the backend cannot render it."""
-    try:
-        text = compiled.as_text()
-    except Exception:
-        return {}
-    if not text:
-        return {}
-    return hlo_comm_report(text)
+jaxpr_report = _shim("jaxpr_report", "jaxpr_report",
+                     _jaxpr_tools.jaxpr_report)
+hlo_comm_report = _shim("hlo_comm_report", "hlo_comm_report",
+                        _hlo_tools.hlo_comm_report)
+comm_report = _shim("comm_report", "comm_report", _hlo_tools.comm_report)
+compiled_memory_stats = _shim("compiled_memory_stats",
+                              "compiled_memory_stats",
+                              _hlo_tools.compiled_memory_stats)
 
 
 def audit_program(program, feed, fetch_list, scope=None, layer_count=None,
                   compile_stats=True, absent_shapes=()):
-    """Lower ``program`` through a fresh Executor, trace the full step
-    (forward+backward+optimizer) and return ``jaxpr_report`` extended
-    with compile-time memory figures.
+    """Deprecated: use ``paddle_tpu.analysis.audit_program``."""
+    if "audit_program" not in _warned:
+        _warned.add("audit_program")
+        warnings.warn(
+            "paddle_tpu.core.memaudit.audit_program is deprecated; use "
+            "paddle_tpu.analysis.audit_program",
+            DeprecationWarning, stacklevel=2)
+    from ..analysis import audit_program as _audit
 
-    ``absent_shapes``: iterable of shape tuples that must NOT appear in
-    the optimized HLO text (e.g. ``(num_layers, t, d_model)`` — the
-    BENCH_r05 failure shape); hit counts land in
-    ``report["absent_shape_hits"]``.
-
-    The scope must already hold the program's parameters (run the
-    startup program into it first).  CPU-safe: used by the tier-1
-    regression test and ``python -m paddle_tpu --memory-selftest``.
-    """
-    import jax
-
-    from .executor import Executor
-
-    exe = Executor()
-    (program, scope, feed_names, fetch_names, feed_vals, state_names,
-     state, _sig) = exe._prepare(program, feed, fetch_list, scope)
-    step, _persist = exe.lower(program, feed_names, fetch_names, state_names)
-    # one trace serves both the jaxpr walk and (via .lower) the compile
-    traced = jax.jit(step).trace(state, *feed_vals)
-    report = jaxpr_report(traced.jaxpr, layer_count=layer_count)
-    report["scan_remat_plan"] = list(getattr(exe, "last_remat_plan", []) or [])
-    if compile_stats:
-        compiled = traced.lower().compile()
-        report.update(compiled_memory_stats(compiled))
-        if absent_shapes:
-            try:
-                text = compiled.as_text()
-            except Exception:
-                text = ""
-            report["absent_shape_hits"] = {
-                tuple(s): len(_shape_pattern(s).findall(text))
-                for s in absent_shapes
-            }
-    return report
+    return _audit(program, feed, fetch_list, scope=scope,
+                  layer_count=layer_count, compile_stats=compile_stats,
+                  absent_shapes=absent_shapes)

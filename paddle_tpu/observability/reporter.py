@@ -122,6 +122,11 @@ class MetricsReporter:
                 collective_count=sc.get("collective_count"),
                 collective_bytes=sc.get("collective_bytes"),
                 reduce_ops_in_loop=sc.get("reduce_ops_in_loop"),
+                # static-analysis findings of the compiled step (the
+                # analysis engine's fold-in via Executor._aot_compile)
+                lint_findings=sc.get("lint_findings"),
+                lint_errors=sc.get("lint_errors"),
+                lint_checks=sc.get("lint_checks"),
             )
         if self.log_every_n and ev.batch_id % self.log_every_n == 0:
             self._print(self._summary_line(ev, wall, throughput, mfu_v,

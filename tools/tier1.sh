@@ -51,6 +51,20 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_multichip.log >&2
     exit 1
 fi
+# static-analysis smoke: the lint pass framework's planted-defect /
+# clean-program contract — every seeded check fires on its deliberately
+# broken Program (dead code, shape-dtype, read-before-write, fetch
+# overwrite, bf16 accum, tanh-in-scan, scan-locality, degraded offload,
+# HBM preflight, donation audit, in-loop collective on the 2-device
+# virtual mesh), the GPT benchmark program lints to ZERO findings, and
+# every examples/ script's program lints clean (docs/analysis.md)
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --lint-selftest \
+        > /tmp/_t1_linttest.log 2>&1; then
+    echo "TIER1 REGRESSION: lint selftest failed" >&2
+    cat /tmp/_t1_linttest.log >&2
+    exit 1
+fi
 # serving smoke: the continuous-batching engine must beat the sequential
 # single-stream baseline (asserted inside --smoke) and print ONE
 # parseable JSON row with the throughput/latency/compile fields
