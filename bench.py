@@ -9,7 +9,9 @@
    reported as tokens/sec/chip and MFU against the chip's bf16 peak.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} for the
-ResNet flagship, with the GPT numbers under "extra".  The numeric/memory
+ResNet flagship, with the GPT numbers under "extra"; every row is
+stamped with schema_version / run_id / git_sha so
+``python -m paddle_tpu --bench-history`` can key it.  The numeric/memory
 gates each run isolated (``run_gates``): a failing gate lands as
 ``"gate_<name>": "FAILED: ..."`` in extra and the flagship line still
 prints (rc nonzero).  The GPT flagship additionally preflights the
@@ -21,7 +23,10 @@ t/2 down to BENCH_GPT_SEQ_FLOOR — a parseable timed row always ships.
 The shipped row carries ``gpt_hbm_high_water_bytes``/``gpt_temp_bytes``
 from ``memory_analysis()``.  BENCH_INFER=1 folds the
 benchmarks/inference.py serving rows (ResNet infer bs16, KV-decode
-tok/s, C-API round trip) into extra.  BENCH_GPT_BLOCK_Q/K tune the
+tok/s, C-API round trip) into extra; BENCH_SERVING=1 folds the
+continuous-batching throughput row (benchmarks/serving.py --smoke) in
+as ``serving_tok_s``/``serving_speedup`` — the keys ``--bench-history``
+tracks across rounds.  BENCH_GPT_BLOCK_Q/K tune the
 flash tile sizes; BENCH_GPT_REMAT selects the memory_optimize policy
 (selective/compact/full/offload).
 """
@@ -38,6 +43,20 @@ def chip_peak_flops(device):
     from paddle_tpu.observability.hardware import device_peak_flops
 
     return device_peak_flops(device)
+
+
+def _stamp(row):
+    """Stamp the row with schema_version / run_id / git_sha so
+    --bench-history can key and join it even when the driver wrapper
+    ships only {n, cmd, rc, tail} around it — BENCH_r05 had nothing to
+    join on.  The stamp contract lives in bench_history.stamp_row; the
+    import guard keeps a broken observability package from killing the
+    row."""
+    try:
+        from paddle_tpu.observability.bench_history import stamp_row
+    except Exception:  # noqa: BLE001 — the stamp must never kill the row
+        return row
+    return stamp_row(row)
 
 
 def timed_steps(exe, prog, feed, fetch, steps, warmup, repeats=None):
@@ -578,6 +597,58 @@ def infer_rows(extra):
     return failed
 
 
+def serving_rows(extra, timeout=900):
+    """Fold the continuous-batching engine's throughput row
+    (benchmarks/serving.py --smoke, its own subprocess: the engine spins
+    a driver thread and compiles its own executables) into ``extra`` as
+    ``serving_tok_s`` / ``serving_speedup`` / TTFT+queue-wait p50s —
+    the keys ``--bench-history`` tracks, so a serving throughput
+    regression shows in the artifact trajectory instead of only in the
+    tier-1 smoke gate.  Enabled by BENCH_SERVING=1."""
+    import subprocess
+    import sys as _sys
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "serving.py")
+    try:
+        proc = subprocess.run([_sys.executable, path, "--smoke"],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        # diagnose rc/empty-stdout BEFORE parsing: a crash that printed
+        # no row must surface the stderr tail, not an IndexError
+        lines = proc.stdout.strip().splitlines()
+        if proc.returncode != 0 or not lines:
+            try:
+                row = json.loads(lines[-1]) if lines else {}
+            except json.JSONDecodeError:
+                row = {}
+            raise RuntimeError(row.get("error")
+                               or f"rc={proc.returncode}: "
+                                  f"{proc.stderr[-300:]}")
+        row = json.loads(lines[-1])
+        if "error" in row:
+            raise RuntimeError(row["error"])
+        for src, dst in (("tok_s", "serving_tok_s"),
+                         ("speedup", "serving_speedup"),
+                         ("ttft_p50_ms", "serving_ttft_p50_ms"),
+                         ("queue_wait_p50_ms",
+                          "serving_queue_wait_p50_ms")):
+            if isinstance(row.get(src), (int, float)):
+                extra[dst] = row[src]
+        if "serving_tok_s" not in extra:
+            # a row that parses but carries no throughput metric would
+            # silently END the serving trajectory in --bench-history
+            # (regression flagging only sees value drops, never a
+            # disappeared metric) — that's the rot class this gate
+            # exists to catch, so it fails loudly instead
+            raise RuntimeError(
+                f"smoke row has no numeric tok_s: {lines[-1][:200]}")
+        return []
+    except Exception as e:  # noqa: BLE001 — isolated like the gates
+        extra["serving_smoke"] = f"FAILED: {_err_str(e)}"
+        return ["serving_smoke"]
+
+
 def detect_devices():
     """jax.devices() behind a seam (tests monkeypatch this to exercise
     the accelerator code path on CPU)."""
@@ -619,21 +690,21 @@ def _print_smoke(errors, extra=None):
         carried["smoke"] = True
         if errors:
             carried["errors"] = errors
-        print(json.dumps({
+        print(json.dumps(_stamp({
             "metric": "smoke_train_images_per_sec",
             "value": round(v, 1),
             "unit": "img/s",
             "vs_baseline": None,
             "extra": carried,
-        }))
+        })))
         return 1 if errors else 0
     except Exception as e:  # noqa: BLE001 — last resort, still emit JSON
         errors = dict(errors, smoke=_err_str(e))
         carried["errors"] = errors
-        print(json.dumps({
+        print(json.dumps(_stamp({
             "metric": "bench_failed", "value": None, "unit": None,
             "vs_baseline": None, "extra": carried,
-        }))
+        })))
         return 1
 
 
@@ -711,6 +782,11 @@ def _main(extra, errors):
         # driver channel behind this guard; their failures flip the rc
         # like the gates (numbers still print)
         gates_failed += infer_rows(extra)
+    if os.environ.get("BENCH_SERVING", "").lower() in ("1", "true", "yes"):
+        # continuous-batching throughput rides along the same way —
+        # serving_tok_s/serving_speedup land in extra where
+        # --bench-history's trajectory tracking reads them
+        gates_failed += serving_rows(extra)
     if errors:
         extra["errors"] = errors
 
@@ -732,23 +808,23 @@ def _main(extra, errors):
     if img_per_chip is None:
         # gpt-only run (BENCH_MODELS=gpt), or resnet failed while gpt
         # succeeded (errors non-empty -> rc 1 either way)
-        print(json.dumps({
+        print(json.dumps(_stamp({
             "metric": "gpt_train_tokens_per_sec_per_chip",
             "value": extra["gpt_tokens_per_sec_per_chip"],
             "unit": "tok/s/chip",
             "vs_baseline": extra["gpt_mfu"],
             "extra": {k: v for k, v in extra.items()
                       if not k.startswith("gpt_tokens")},
-        }))
+        })))
         return rc
     target_per_chip = 3000.0 / 16.0
-    print(json.dumps({
+    print(json.dumps(_stamp({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_per_chip / target_per_chip, 3),
         "extra": extra,
-    }))
+    })))
     return rc
 
 

@@ -51,6 +51,18 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _stamp(row):
+    """schema_version / run_id / git_sha row identity for
+    ``python -m paddle_tpu --bench-history`` — the stamp contract lives
+    in bench_history.stamp_row; the import guard keeps a broken
+    observability package from killing the row."""
+    try:
+        from paddle_tpu.observability.bench_history import stamp_row
+    except Exception:  # noqa: BLE001 — the stamp must never kill the row
+        return row
+    return stamp_row(row)
+
+
 def _devices_ready(n):
     """True when this process already exposes >= n CPU devices."""
     if "jax" not in sys.modules:
@@ -344,7 +356,7 @@ def run_smoke(devices=8):
     the MULTICHIP artifact carries scaling numbers, not just OK).  The
     caller guarantees >= ``devices`` CPU devices.  Always returns a row;
     gate failures are recorded in it."""
-    row = {"metric": "multichip_scaling", "mode": "smoke"}
+    row = _stamp({"metric": "multichip_scaling", "mode": "smoke"})
     try:
         run(row, devices=devices, smoke=True)
     except Exception as e:  # noqa: BLE001 — the row must still carry why
@@ -369,8 +381,8 @@ def main(argv=None):
                                 else sys.argv[1:]))
         _provision_env(args.devices)
 
-    row = {"metric": "multichip_scaling",
-           "mode": "smoke" if args.smoke else "full"}
+    row = _stamp({"metric": "multichip_scaling",
+                  "mode": "smoke" if args.smoke else "full"})
     models = [m for m in args.models.split(",") if m]
     if args.smoke:
         models = ["transformer"]

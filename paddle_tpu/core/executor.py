@@ -547,9 +547,23 @@ class Executor:
             ):
                 a = np.asarray(arr)
                 if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
-                    raise FloatingPointError(
+                    # make the abort observable (metrics + timeline),
+                    # not just a propagating exception
+                    _obs.get_registry().counter(
+                        "executor.nan_trips",
+                        help="NaN/Inf aborts caught by nan_guard / "
+                             "check_nan_inf").inc()
+                    from ..observability import trace as _trace
+
+                    _trace.get_tracer().instant(
+                        "nan_guard_trip", cat="executor", var=name)
+                    err = FloatingPointError(
                         f"NaN/Inf detected in {name!r} after step"
                     )
+                    # already recorded here: nan_guard() must not count
+                    # the same abort a second time on the way out
+                    err._pt_nan_counted = True
+                    raise err
         if FLAGS.do_memory_benchmark:
             total = sum(
                 np.asarray(v).nbytes for v in new_state.values()
@@ -1038,12 +1052,20 @@ class Executor:
                                     ys = {m: e2[m] for m in ys_names}
                                     return new_carry, ys
 
-                                carry_f, ys = jax.lax.scan(
-                                    body,
-                                    carry0,
-                                    (jnp.arange(G, dtype=jnp.int32),
-                                     xs_stacked),
-                                    length=G)
+                                # named scope -> XLA op metadata: XPlane
+                                # captures (profiler('dir') / Trainer
+                                # trace_dir=) show this group as
+                                # "scan_remat[i0+PxG]" so device timelines
+                                # line up with the Program's layer
+                                # structure
+                                with jax.named_scope(
+                                        f"scan_remat[{i0}+{P}x{G}]"):
+                                    carry_f, ys = jax.lax.scan(
+                                        body,
+                                        carry0,
+                                        (jnp.arange(G, dtype=jnp.int32),
+                                         xs_stacked),
+                                        length=G)
                                 for on, m, k in sorted(ys_writes,
                                                        key=lambda w: w[2]):
                                     e[on] = ys[m][k]

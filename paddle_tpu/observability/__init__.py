@@ -15,7 +15,14 @@ Modules:
 * ``hardware`` — chip peak-FLOPs table, `mfu`, `device_memory_stats`,
   `sample_memory` HBM high-water gauges;
 * ``reporter`` — `MetricsReporter`, the Trainer event handler emitting
-  one-line summaries + JSONL step records.
+  one-line summaries + JSONL step records;
+* ``trace``    — span-based tracing runtime (`Tracer`: nested spans,
+  instants, per-request lanes) with Chrome-trace/Perfetto export; span
+  durations fold into the ``host_timer.`` histogram namespace;
+* ``bench_history`` — BENCH_*/MULTICHIP_* artifact trajectory: failed-
+  artifact classification + best-so-far regression flagging (the
+  ``python -m paddle_tpu --bench-history`` CI gate), plus `run_stamp`
+  (schema_version / run_id / git sha) every bench row carries.
 
 Quick start::
 
@@ -27,7 +34,8 @@ Quick start::
     print(get_registry().to_text())   # or start_metrics_server(9464)
 """
 
-from . import hardware, metrics, reporter, runlog
+from . import bench_history, hardware, metrics, reporter, runlog, trace
+from .bench_history import run_stamp
 from .hardware import (
     device_memory_stats, device_peak_flops, mfu, sample_memory,
     total_peak_flops,
@@ -38,11 +46,13 @@ from .metrics import (
 )
 from .reporter import MetricsReporter
 from .runlog import RunLog, read_jsonl
+from .trace import Tracer, get_tracer, set_tracer
 
 __all__ = [
-    "metrics", "runlog", "hardware", "reporter",
+    "metrics", "runlog", "hardware", "reporter", "trace", "bench_history",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "start_metrics_server", "RunLog", "read_jsonl", "MetricsReporter",
     "device_peak_flops", "total_peak_flops", "mfu",
     "device_memory_stats", "sample_memory",
+    "Tracer", "get_tracer", "set_tracer", "run_stamp",
 ]

@@ -1,0 +1,292 @@
+"""Bench-history engine: read every ``BENCH_*.json`` / ``MULTICHIP_*.json``
+artifact the driver captured, classify the broken ones, and flag metric
+regressions against best-so-far — the tooling whose absence let
+``BENCH_r05`` (rc=1, no parseable row) rot silently on disk (ROADMAP
+Open item 1).
+
+An artifact is the driver's wrapper around one benchmark invocation::
+
+    {"n": 4, "cmd": "...", "rc": 0, "tail": "...", "parsed": {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 2326.18, "unit": "img/s/chip", "vs_baseline": 12.4,
+        "extra": {"gpt_tokens_per_sec_per_chip": 115689.9, ...}}}
+
+Classification (``classify_artifact``) marks an artifact FAILED when its
+``rc`` is nonzero, its row is missing/unparseable, or the row lacks the
+required keys (``metric``/``value``) — each with a reason string.
+
+Regression detection (``history``) builds one trajectory per tracked
+metric (all are higher-is-better: img/s, tok/s, MFU, plus serving
+tok/s/speedup when the driver runs bench.py with ``BENCH_SERVING=1``)
+ordered by round and flags any value more than ``threshold`` (default
+10%) below the best seen so far; multichip ``scaling_efficiency``
+shows in the trajectory but is exempt from flagging (virtual-CPU-mesh
+step times are indicative only).  Known,
+root-caused failures are acknowledged via a JSON file
+(``tools/bench_known_failures.json``) so the CI gate
+(``python -m paddle_tpu --bench-history`` in tools/tier1.sh) fails on
+NEW rot without flapping on the already-tracked one.  Acks are scoped
+to the rot class: ``{"BENCH_r05.json": reason}`` covers that
+artifact's classification *failure*; a flagged *regression* needs its
+own ``{"BENCH_r05.json:gpt_mfu": reason}`` key — one artifact's
+failure ack never green-lights a different, future defect in it.
+
+Rows printed by bench.py / benchmarks/multichip.py / benchmarks/
+serving.py are stamped with ``run_stamp()`` (``schema_version`` /
+``run_id`` / ``git_sha``) so trajectories can be keyed and joined even
+when the wrapper-level fields change.
+"""
+
+import glob
+import json
+import os
+import re
+import uuid
+
+__all__ = [
+    "SCHEMA_VERSION", "run_stamp", "stamp_row", "scan_artifacts",
+    "classify_artifact", "history", "format_table",
+]
+
+SCHEMA_VERSION = 1
+
+# metric fields tracked across rounds — every one is higher-is-better
+_EXTRA_METRICS = (
+    "gpt_tokens_per_sec_per_chip", "gpt_mfu",
+)
+_MULTICHIP_METRICS = ("scaling_efficiency",)
+_SERVING_METRICS = ("tok_s", "speedup")
+# surfaced in the trajectory table but EXEMPT from regression flagging:
+# virtual-CPU-mesh step times share host cores and are indicative only
+# (benchmarks/multichip.py) — the multichip gates are the contract there
+_REGRESSION_EXEMPT = frozenset(_MULTICHIP_METRICS)
+
+
+def run_stamp(cwd=None):
+    """The row identity stamp every bench row carries: schema version,
+    a fresh run id, and the repo git sha (None outside a checkout)."""
+    sha = None
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=cwd or os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True, text=True, timeout=10)
+        sha = out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:  # noqa: BLE001 — the stamp must never kill a bench
+        sha = None
+    return {"schema_version": SCHEMA_VERSION,
+            "run_id": uuid.uuid4().hex[:12],
+            "git_sha": sha}
+
+
+def stamp_row(row):
+    """Apply :func:`run_stamp` to a bench row in place and return it —
+    exception-safe, because the stamp must never kill the row (the
+    one-parseable-JSON-line contract outranks row identity).  This is
+    the ONE place the stamp contract lives; bench.py and the
+    benchmarks/ scripts all route through it."""
+    try:
+        row.update(run_stamp())
+    except Exception:  # noqa: BLE001
+        pass
+    return row
+
+
+def scan_artifacts(root):
+    """Sorted artifact paths under ``root`` (BENCH then MULTICHIP,
+    round order within each)."""
+
+    def key(p):
+        name = os.path.basename(p)
+        m = re.search(r"_r(\d+)", name)
+        return (name.split("_")[0], int(m.group(1)) if m else 0, name)
+
+    paths = (glob.glob(os.path.join(root, "BENCH_*.json"))
+             + glob.glob(os.path.join(root, "MULTICHIP_*.json")))
+    return sorted(paths, key=key)
+
+
+def _round_of(name, data):
+    n = data.get("n")
+    if isinstance(n, int):
+        return n
+    m = re.search(r"_r(\d+)", name)
+    return int(m.group(1)) if m else 0
+
+
+def _row_from_tail(data):
+    """The LAST parseable one-line JSON row with a ``metric`` key found
+    in the wrapper's captured ``tail`` — the multichip artifacts carry
+    their scaling row only there (the wrapper has no ``parsed`` field
+    for them), and a bench row that printed but failed wrapper-side
+    parsing is still recoverable this way."""
+    tail = data.get("tail")
+    if not isinstance(tail, str):
+        return None
+    row = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # truncated / non-row line
+        if isinstance(obj, dict) and "metric" in obj:
+            row = obj
+    return row
+
+
+def classify_artifact(path):
+    """One artifact -> classification row: ``{artifact, kind, round, rc,
+    ok, reasons, metrics, run_id, git_sha}``."""
+    name = os.path.basename(path)
+    kind = "multichip" if name.startswith("MULTICHIP") else "bench"
+    row = {"artifact": name, "kind": kind, "round": 0, "rc": None,
+           "ok": True, "reasons": [], "metrics": {},
+           "run_id": None, "git_sha": None}
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        row["ok"] = False
+        row["reasons"].append(f"unreadable artifact: {e}")
+        return row
+    if not isinstance(data, dict):
+        # valid JSON but not an object (truncated/corrupt write that
+        # still parses) — classify the rot, don't crash the gate on it
+        row["ok"] = False
+        row["reasons"].append(
+            f"artifact is not a JSON object ({type(data).__name__})")
+        m = re.search(r"_r(\d+)", name)
+        row["round"] = int(m.group(1)) if m else 0
+        return row
+    row["round"] = _round_of(name, data)
+    rc = data.get("rc")
+    row["rc"] = rc
+    if rc not in (0, None):
+        row["reasons"].append(f"rc={rc}")
+    if kind == "bench":
+        parsed = data.get("parsed")
+        if not isinstance(parsed, dict):
+            # the wrapper failed to parse stdout — the row may still be
+            # recoverable from the captured tail (wrapper rot, not
+            # bench rot)
+            parsed = _row_from_tail(data)
+        if not isinstance(parsed, dict):
+            row["reasons"].append("no parseable row (parsed is null)")
+        else:
+            for k in ("metric", "value"):
+                if parsed.get(k) is None:
+                    row["reasons"].append(f"row missing key {k!r}")
+            row["run_id"] = parsed.get("run_id")
+            row["git_sha"] = parsed.get("git_sha")
+            metric, value = parsed.get("metric"), parsed.get("value")
+            if isinstance(metric, str) and isinstance(value, (int, float)):
+                row["metrics"][metric] = float(value)
+            extra = parsed.get("extra") or {}
+            for k in _EXTRA_METRICS:
+                v = extra.get(k)
+                if isinstance(v, (int, float)):
+                    row["metrics"][k] = float(v)
+            for k in _SERVING_METRICS:
+                v = extra.get(f"serving_{k}")
+                if isinstance(v, (int, float)):
+                    row["metrics"][f"serving_{k}"] = float(v)
+    else:  # multichip
+        if data.get("ok") is False:
+            row["reasons"].append("ok=false")
+        # the scaling row lives in the wrapper's tail (dryrun_multichip
+        # prints it to stdout; the wrapper has no parsed field here)
+        src = _row_from_tail(data) or data
+        row["run_id"] = src.get("run_id")
+        row["git_sha"] = src.get("git_sha")
+        if src is not data and "error" in src:
+            row["reasons"].append(
+                f"row error: {str(src['error'])[:120]}")
+        for k in _MULTICHIP_METRICS:
+            v = src.get(k)
+            if isinstance(v, (int, float)):
+                row["metrics"][k] = float(v)
+    row["ok"] = not row["reasons"]
+    return row
+
+
+def history(root, threshold=0.1, known_failures=None):
+    """Classify every artifact under ``root`` and detect regressions.
+
+    Returns ``(summary, rows)``: ``rows`` is the per-artifact
+    classification; ``summary`` is ONE json-able row with ``failed`` /
+    ``acknowledged`` / ``regressions`` and ``ok`` — the CI gate is
+    ``summary["ok"]`` (True iff every failure is acknowledged under its
+    artifact name and every regression under ``artifact:metric`` in the
+    ``known_failures`` dict)."""
+    known = dict(known_failures or {})
+    rows = [classify_artifact(p) for p in scan_artifacts(root)]
+    series = {}  # metric -> [(round, artifact, value)] in round order
+    for row in sorted(rows, key=lambda r: (r["round"], r["artifact"])):
+        for metric, value in row["metrics"].items():
+            series.setdefault(metric, []).append(
+                (row["round"], row["artifact"], value))
+    regressions = []
+    for metric, points in sorted(series.items()):
+        if metric in _REGRESSION_EXEMPT:
+            continue
+        best, best_at = None, None
+        for rnd, artifact, value in points:
+            if best is not None and value < best * (1.0 - threshold):
+                regressions.append({
+                    "metric": metric, "round": rnd, "artifact": artifact,
+                    "value": value, "best": best, "best_round": best_at,
+                    "drop": round(1.0 - value / best, 4),
+                })
+            if best is None or value > best:
+                best, best_at = value, rnd
+    failed = [r["artifact"] for r in rows if not r["ok"]]
+    # acks are scoped to the rot class they root-caused: a plain
+    # artifact key covers that artifact's classification FAILURE; a
+    # regression needs its own "artifact:metric" key — otherwise the
+    # BENCH_r05 failure ack would silently green-light a future metric
+    # regression in the regenerated artifact (new rot must fail CI)
+    reg_keys = {f"{r['artifact']}:{r['metric']}" for r in regressions}
+    acknowledged = sorted(
+        set(a for a in failed if a in known)
+        | set(k for k in reg_keys if k in known))
+    unacknowledged = (
+        [a for a in failed if a not in known]
+        + sorted(k for k in reg_keys if k not in known))
+    summary = {
+        "metric": "bench_history",
+        "schema_version": SCHEMA_VERSION,
+        "root": os.path.abspath(root),
+        "threshold": threshold,
+        "artifacts": len(rows),
+        "rounds": sorted({r["round"] for r in rows}),
+        "metrics_tracked": sorted(series),
+        "failed": failed,
+        "failed_reasons": {r["artifact"]: r["reasons"]
+                           for r in rows if not r["ok"]},
+        "acknowledged": acknowledged,
+        "regressions": regressions,
+        "ok": not unacknowledged,
+    }
+    return summary, rows
+
+
+def format_table(rows):
+    """Human-readable trajectory table (stderr companion of the JSON
+    summary row)."""
+    out = [f"{'artifact':<22}{'round':>6}{'rc':>4}{'ok':>4}  metrics"]
+    for r in rows:
+        mets = " ".join(
+            f"{k}={v:g}" for k, v in sorted(r["metrics"].items()))
+        if not r["ok"]:
+            mets = (mets + " " if mets else "") + \
+                "FAILED: " + "; ".join(r["reasons"])
+        out.append(f"{r['artifact']:<22}{r['round']:>6}"
+                   f"{str(r['rc']):>4}{('y' if r['ok'] else 'N'):>4}"
+                   f"  {mets}")
+    return "\n".join(out)

@@ -22,6 +22,7 @@ import time
 import jax
 
 from .observability import metrics as _obs
+from .observability import trace as _trace
 
 # registry namespace for host-side phase timers
 TIMER_PREFIX = "host_timer."
@@ -44,11 +45,17 @@ def reset_profiler():
     _obs.get_registry().clear(prefix=TIMER_PREFIX)
 
 
-def print_profiler(sorted_key="total"):
+def print_profiler(sorted_key="total", log=None):
     """PrintProfiler analog: aggregated host timer table with the share
     of total timed seconds per event.  ``sorted_key`` must be one of
     ``total`` / ``calls`` / ``ave`` / ``max`` — anything else raises
-    (silently falling back to ``total`` hid typos)."""
+    (silently falling back to ``total`` hid typos).
+
+    ``log=`` takes a ``RunLog`` (or anything with ``.log(event,
+    **fields)``) and ALSO emits the aggregation as one structured
+    ``profiler`` JSONL record — the same numbers as the printed table
+    and the Prometheus exposition, closing the one-aggregation-path
+    contract for offline analysis too."""
     keys = {"total": 1, "calls": 2, "ave": 3, "max": 4}
     if sorted_key not in keys:
         raise ValueError(
@@ -70,6 +77,12 @@ def print_profiler(sorted_key="total"):
             f"{100.0 * total / grand:>8.2f}")
     table = "\n".join(out)
     print(table)
+    if log is not None:
+        log.log("profiler", sorted_key=sorted_key,
+                timers=[{"event": name, "total": total, "calls": calls,
+                         "ave": ave, "max": mx,
+                         "pct": round(100.0 * total / grand, 2)}
+                        for name, total, calls, ave, mx in rows])
     return table
 
 
@@ -86,10 +99,26 @@ def profiler(log_dir="/tmp/paddle_tpu_profile", state=None):
 
 @contextlib.contextmanager
 def nan_guard():
-    """FLAGS_check_nan_inf analog: raise on NaN in any jitted computation."""
+    """FLAGS_check_nan_inf analog: raise on NaN in any jitted computation.
+
+    A trip is recorded before re-raising — ``executor.nan_trips``
+    counter + a ``nan_guard_trip`` instant event in the trace timeline —
+    so a debug_nans abort is visible in metrics and the Chrome trace,
+    not just as a propagating exception."""
     prev = jax.config.jax_debug_nans
     jax.config.update("jax_debug_nans", True)
     try:
         yield
+    except FloatingPointError as e:
+        # check_nan_inf aborts from Executor._finish are recorded at the
+        # raise site and marked; don't count the same abort twice
+        if not getattr(e, "_pt_nan_counted", False):
+            _obs.get_registry().counter(
+                "executor.nan_trips",
+                help="NaN/Inf aborts caught by nan_guard / check_nan_inf",
+            ).inc()
+            _trace.get_tracer().instant(
+                "nan_guard_trip", cat="executor", error=str(e)[:200])
+        raise
     finally:
         jax.config.update("jax_debug_nans", prev)

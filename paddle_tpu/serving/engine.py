@@ -32,7 +32,13 @@ request alone through ``transformer.generate`` (same per-row math; see
 ``batched_decode``).  Telemetry flows through the global observability
 registry under ``serving.*`` (queue depth, slot occupancy, admitted /
 completed / token counters, TTFT + per-step + e2e histograms, tok/s
-gauge, compile counters).
+gauge, compile counters) — plus the TTFT decomposition pair
+``serving.queue_wait`` (submit -> admission pop) and
+``serving.decode_chunk`` (per chunk call), the measurement SLO-aware
+admission needs.  With tracing enabled (``observability.trace``,
+default on) every finished request also lays a span tree on its own
+timeline lane — submit -> queue -> prefill(bucket) -> per-decode-chunk
+-> evict — exported to Chrome-trace via ``trace.save(path)``.
 """
 
 import collections
@@ -42,6 +48,7 @@ import time
 import numpy as np
 
 from ..observability import metrics as _obs
+from ..observability import trace as _trace
 from . import batched_decode as _bd
 
 __all__ = ["Request", "ServingEngine"]
@@ -60,7 +67,8 @@ class Request:
 
     __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens",
                  "submit_t", "first_token_t", "finish_t", "error",
-                 "_done")
+                 "admit_t", "prefill_t0", "prefill_t1", "bucket",
+                 "chunks", "_done")
 
     def __init__(self, rid, prompt, max_new, eos_id):
         self.rid = rid
@@ -72,6 +80,15 @@ class Request:
         self.first_token_t = None
         self.finish_t = None
         self.error = None
+        # span-tree timestamps (observability.trace): queue pop, prefill
+        # window, prefill bucket, and the decode-chunk windows this
+        # request was live for — the request's timeline lane is emitted
+        # from these when it finishes
+        self.admit_t = None
+        self.prefill_t0 = None
+        self.prefill_t1 = None
+        self.bucket = None
+        self.chunks = []
         self._done = threading.Event()
 
     @property
@@ -183,11 +200,19 @@ class ServingEngine:
         self._error = None                # fatal error: engine is dead
         self._inflight = 0                # popped from queue, not yet
                                           # slotted (visible to idle)
+        self._req_lane_ends = []          # trace lane i -> last finish_t
 
         self._reg = registry or _obs.get_registry()
         self._reg.gauge("serving.slots_total").set(self.max_slots)
         self._reg.gauge("serving.slots_active").set(0)
         self._reg.gauge("serving.queue_depth").set(0)
+
+    @property
+    def _tracer(self):
+        # resolved per call, not bound at construction, so a tracer
+        # installed via trace.set_tracer() after the engine exists (the
+        # test pattern) still receives the request span trees
+        return _trace.get_tracer()
 
     # -- request intake ---------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_id=None):
@@ -441,9 +466,25 @@ class ServingEngine:
         self._ck, self._cv, self._last, self._pos, toks = self._decode_fn(
             self._p, self._ck, self._cv, self._last, self._pos)
         toks = np.asarray(toks)  # host sync: [chunk, S]
-        wall = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        wall = t1 - t0
         self._reg.histogram("serving.step_seconds").observe(
             wall / self.decode_chunk)
+        # per-chunk-call latency (ISSUE 7 TTFT/TPOT decomposition) + the
+        # driver-thread timeline span; every live request also records
+        # this window for its own lane (emitted at finish)
+        self._reg.histogram("serving.decode_chunk").observe(wall)
+        tracer = self._tracer
+        tracer.add_span("serving.decode_chunk", t0, t1,
+                        cat="serving", steps=self.decode_chunk,
+                        active=self.active_slots)
+        if tracer.enabled:
+            # per-request chunk windows feed only the finish-time lane
+            # emission, which is skipped when tracing is off — don't
+            # grow the lists on the disabled hot path
+            for req in self._slots:
+                if req is not None:
+                    req.chunks.append((t0, t1))
         emitted = 0
         finished = 0
         now = time.perf_counter()
@@ -483,21 +524,34 @@ class ServingEngine:
                 self._inflight += 1
                 self._reg.gauge("serving.queue_depth").set(
                     len(self._queue))
+            # queue-wait: submit -> popped for admission.  With the
+            # prefill window below this decomposes TTFT into queue time
+            # vs prefill compute — the measurement SLO-aware admission
+            # (ROADMAP item 3) schedules against.
+            req.admit_t = time.perf_counter()
+            self._reg.histogram("serving.queue_wait").observe(
+                req.admit_t - req.submit_t)
             try:
                 slot = self._free.pop()
                 p_len = req.prompt.shape[0]
                 bucket = self.bucket_for(p_len)
+                req.bucket = bucket
                 fn = self._prefill_fn(bucket)
                 padded = np.zeros(bucket, np.int32)
                 padded[:p_len] = req.prompt
-                with self._reg.histogram(
-                        "serving.prefill_seconds").time():
-                    (self._ck, self._cv, self._last, self._pos,
-                     first) = fn(self._p, self._ck, self._cv, self._last,
-                                 self._pos, np.int32(slot),
-                                 jnp.asarray(padded), np.int32(p_len))
-                    first = int(np.asarray(first))  # host sync
+                t_p0 = time.perf_counter()
+                (self._ck, self._cv, self._last, self._pos,
+                 first) = fn(self._p, self._ck, self._cv, self._last,
+                             self._pos, np.int32(slot),
+                             jnp.asarray(padded), np.int32(p_len))
+                first = int(np.asarray(first))  # host sync
                 now = time.perf_counter()
+                req.prefill_t0, req.prefill_t1 = t_p0, now
+                self._reg.histogram("serving.prefill_seconds").observe(
+                    now - t_p0)
+                self._tracer.add_span("serving.prefill", t_p0, now,
+                                      cat="serving", rid=req.rid,
+                                      bucket=bucket, slot=slot)
                 req.first_token_t = now
                 req.tokens.append(first)
                 self._reg.counter("serving.admitted").inc()
@@ -527,9 +581,66 @@ class ServingEngine:
         req.finish_t = now
         self._reg.counter("serving.completed").inc()
         self._reg.histogram("serving.e2e_seconds").observe(req.e2e)
+        self._emit_request_trace(req)
         with self._qlock:
             self._completed.append(req)
         req._done.set()
+
+    def _emit_request_trace(self, req):
+        """Lay the finished request's span tree on its own timeline lane:
+        ``serving.request`` (submit -> finish) containing
+        ``serving.req.queue`` / ``serving.req.prefill`` / one
+        ``serving.req.decode_chunk`` per chunk the request was live for,
+        closed by a zero-duration ``serving.req.evict`` marker.  These
+        lane spans RE-present intervals the dedicated histograms
+        (``serving.queue_wait`` / ``prefill_seconds`` /
+        ``decode_chunk`` / ``e2e_seconds``) and the driver-thread
+        operational spans already observed — one decode chunk is shared
+        by every live request — so they are timeline-only
+        (``timer=False``): folding them into ``host_timer.`` would
+        multi-count the same wall seconds in the aggregate view."""
+        tr = self._tracer
+        if not tr.enabled or req.error is not None or req.admit_t is None:
+            return
+        lane = f"serving req {self._req_lane(req)}"
+        tr.add_span("serving.request", req.submit_t, req.finish_t,
+                    cat="serving", lane=lane, timer=False, rid=req.rid,
+                    prompt_len=int(req.prompt.shape[0]),
+                    tokens=len(req.tokens))
+        tr.add_span("serving.req.queue", req.submit_t, req.admit_t,
+                    cat="serving", lane=lane, timer=False, rid=req.rid)
+        if req.prefill_t0 is not None:
+            tr.add_span("serving.req.prefill", req.prefill_t0,
+                        req.prefill_t1, cat="serving", lane=lane,
+                        timer=False, rid=req.rid, bucket=req.bucket)
+        for c0, c1 in req.chunks:
+            tr.add_span("serving.req.decode_chunk", c0, c1,
+                        cat="serving", lane=lane, timer=False,
+                        rid=req.rid)
+        tr.add_span("serving.req.evict", req.finish_t, req.finish_t,
+                    cat="serving", lane=lane, timer=False, rid=req.rid)
+
+    def _req_lane(self, req):
+        """Pick a timeline lane whose previous occupant finished before
+        this request was submitted, so overlapping requests NEVER share
+        a lane (Chrome/Perfetto derive nesting purely from ts/dur
+        containment within a tid — two live requests on one lane would
+        render as one false tree).  Lanes are reused once free, keeping
+        the lane count at the peak request concurrency; only past 64
+        simultaneously-live requests does reuse fall back to the
+        least-recently-freed lane.  Driver-thread only (called from
+        ``_finish``), so no lock."""
+        ends = self._req_lane_ends
+        for i, end in enumerate(ends):
+            if end <= req.submit_t:
+                ends[i] = req.finish_t
+                return i
+        if len(ends) < 64:
+            ends.append(req.finish_t)
+            return len(ends) - 1
+        i = min(range(len(ends)), key=ends.__getitem__)
+        ends[i] = req.finish_t
+        return i
 
     def stats(self):
         """Snapshot of the engine's ``serving.*`` metrics."""

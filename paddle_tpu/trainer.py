@@ -8,6 +8,7 @@ pattern is preserved exactly; the body of a step is one jitted program run.
 
 import time
 
+import jax
 import numpy as np
 
 from .core.executor import Executor
@@ -16,6 +17,7 @@ from .core.scope import global_scope
 from .data_feeder import DataFeeder
 from .observability import hardware as _hardware
 from .observability import metrics as _obs
+from .observability import trace as _trace
 from . import profiler as _profiler
 from . import io as _io
 
@@ -88,6 +90,7 @@ class Trainer:
         self.extra_fetch = extra_fetch or []
         self._initialized = False
         self._peak_flops_cache = None
+        self._global_step = 0  # StepTraceAnnotation step_num across passes
 
     def init_params(self):
         self.exe.run(self.startup_program)
@@ -96,7 +99,8 @@ class Trainer:
     def train(self, reader, num_passes=1, event_handler=None,
               checkpoint_dir=None, checkpoint_every_n_passes=1,
               async_checkpoint=False, prefetch=0, steps_per_call=1,
-              fused_group=8, probe_samples=6):
+              fused_group=8, probe_samples=6, trace_dir=None,
+              trace_start=1, trace_steps=2):
         """``async_checkpoint=True`` writes per-pass checkpoints from a
         background thread (io.AsyncCheckpointer): training only pays the
         device->host snapshot, not serialization + disk IO.  Pending
@@ -123,7 +127,26 @@ class Trainer:
         the device) is the bottleneck.  Batches whose padded shapes
         differ run unfused (shape buckets compile separately anyway);
         incompatible with ``prefetch`` (the pipe already overlaps the
-        host gap there)."""
+        host gap there).
+
+        Every step is traced: a ``jax.profiler.StepTraceAnnotation``
+        plus host spans (``trainer.step`` containing feed_h2d /
+        dispatch / device_sync / opt_boundary, with reader_wait just
+        before it — the step window opens once a batch is in hand) into
+        the global
+        span tracer (``observability.trace`` — Chrome-trace export,
+        durations aggregated under ``host_timer.trainer.*``;
+        ``PADDLE_TPU_TRACE=0`` disables at near-zero cost).
+        ``trace_dir=`` additionally captures an XPlane device trace
+        (TensorBoard/xprof, the ``profiler('dir')`` path) for THIS
+        call's step window ``[trace_start, trace_start + trace_steps)``
+        — this call's step 0 is usually the compile, so the default
+        window starts at 1; the window fires once per train() call and
+        the scan-remat groups appear there under ``scan_remat[...]``
+        named scopes.  ``trace_dir`` requires the unfused path: with
+        ``steps_per_call != 1`` there is no per-step host boundary to
+        window on (the group is one device call), so the combination
+        raises rather than silently capturing nothing."""
         if not self._initialized:
             self.init_params()
         event_handler = event_handler or (lambda e: None)
@@ -131,6 +154,13 @@ class Trainer:
         if steps_per_call != 1 and prefetch:
             raise ValueError("steps_per_call and prefetch are mutually "
                              "exclusive (prefetch already hides host time)")
+        if steps_per_call != 1 and trace_dir:
+            raise ValueError(
+                "trace_dir requires steps_per_call=1: the fused path "
+                "runs whole step groups as one device call, so there "
+                "is no per-step boundary to window the XPlane capture "
+                "on (an empty trace directory would be the only "
+                "symptom)")
         if steps_per_call != 1:
             return self._train_fused(reader, num_passes, event_handler,
                                      checkpoint_dir,
@@ -154,6 +184,12 @@ class Trainer:
         ckpt = _io.AsyncCheckpointer() if (
             checkpoint_dir and async_checkpoint) else None
         reg = _obs.get_registry()
+        tracer = _trace.get_tracer()
+        xplane_on = False
+        xplane_done = False
+        call_step = 0  # THIS call's step count: the trace_dir window is
+        #                per-call (self._global_step keeps counting across
+        #                train() calls for StepTraceAnnotation)
         try:
             for pass_id in range(num_passes):
                 event_handler(BeginPass(pass_id))
@@ -169,31 +205,99 @@ class Trainer:
                         item = next(it)
                     except StopIteration:
                         break
-                    reader_wait = time.perf_counter() - t_wait
+                    t_have = time.perf_counter()
+                    reader_wait = t_have - t_wait
+                    tracer.add_span("trainer.reader_wait", t_wait, t_have,
+                                    cat="trainer", pass_id=pass_id,
+                                    batch=batch_id)
                     reg.gauge("trainer.reader_wait_seconds").set(reader_wait)
                     reg.counter("trainer.reader_wait_seconds_total").inc(
                         reader_wait)
                     event_handler(BeginIteration(pass_id, batch_id))
+                    step_num = self._global_step
+                    self._global_step += 1
+                    if trace_dir and not xplane_on and not xplane_done \
+                            and call_step >= trace_start:
+                        jax.profiler.start_trace(trace_dir)
+                        xplane_on = True
                     t0 = time.perf_counter()
-                    with _profiler.timer("train_batch"):
-                        feed = item if prefetch else self.feeder.feed(item)
-                        vals = self.exe.run(
-                            self.main_program,
-                            feed=feed,
-                            fetch_list=fetch,
-                        )
-                    cost = float(np.asarray(vals[0]).reshape(-1)[0])
-                    wall = time.perf_counter() - t0
-                    metrics = [np.asarray(v) for v in vals[1:]]
-                    event_handler(EndIteration(
-                        pass_id, batch_id, cost, metrics,
-                        reader_wait=reader_wait,
-                        **self._step_telemetry(wall, feed)))
+                    with jax.profiler.StepTraceAnnotation(
+                            "train", step_num=step_num), \
+                            tracer.span("trainer.step", cat="trainer",
+                                        timer=False, pass_id=pass_id,
+                                        batch=batch_id, step=step_num):
+                        # the step span is timeline-only (timer=False):
+                        # its window is exactly the sum of the phase
+                        # spans below, which carry the host_timer.*
+                        # aggregation — folding both would double-count
+                        # every step's wall seconds in print_profiler's
+                        # %-of-total.  The old train_batch timer (feed
+                        # conversion + device step + fetch
+                        # materialization) is superseded here by its
+                        # exact decomposition feed_h2d + dispatch +
+                        # device_sync; it lives on in the fused path,
+                        # where the group is one device call with no
+                        # per-phase boundary.  The sync must stay a
+                        # phase of its own — dispatch alone returns
+                        # before compute finishes.
+                        with tracer.span("trainer.feed_h2d",
+                                         cat="trainer",
+                                         prefetched=bool(prefetch)):
+                            feed = (item if prefetch
+                                    else self.feeder.feed(item))
+                        # dispatch: compile-or-cache-hit + enqueue of
+                        # the device step (async under jax; a compile
+                        # shows up as a long first-dispatch span)
+                        with tracer.span("trainer.dispatch",
+                                         cat="trainer"):
+                            vals = self.exe.run(
+                                self.main_program,
+                                feed=feed,
+                                fetch_list=fetch,
+                                return_numpy=False,
+                            )
+                        # device_sync: host blocks materializing
+                        # fetches
+                        with tracer.span("trainer.device_sync",
+                                         cat="trainer"):
+                            vals = [np.asarray(v) for v in vals]
+                        cost = float(vals[0].reshape(-1)[0])
+                        wall = time.perf_counter() - t0
+                        # opt_boundary: host-side step-boundary work after
+                        # the fused fwd+bwd+optimizer device step — state
+                        # handoff done, telemetry + event fan-out
+                        with tracer.span("trainer.opt_boundary",
+                                         cat="trainer"):
+                            metrics = vals[1:]
+                            event_handler(EndIteration(
+                                pass_id, batch_id, cost, metrics,
+                                reader_wait=reader_wait,
+                                **self._step_telemetry(wall, feed)))
+                    call_step += 1
+                    if xplane_on and \
+                            call_step >= trace_start + trace_steps:
+                        jax.profiler.stop_trace()
+                        xplane_on = False
+                        xplane_done = True
                     batch_id += 1
                 self._pass_checkpoint(pass_id, ckpt, checkpoint_dir,
                                       checkpoint_every_n_passes)
                 event_handler(EndPass(pass_id))
         finally:
+            if xplane_on:
+                jax.profiler.stop_trace()
+            elif trace_dir and not xplane_done:
+                # the capture window never opened (the call ran fewer
+                # than trace_start+1 steps) — an empty trace directory
+                # must not be the only symptom
+                import warnings
+
+                warnings.warn(
+                    f"trace_dir={trace_dir!r}: no XPlane capture — this "
+                    f"train() call ran {call_step} step(s), the window "
+                    f"starts at step {trace_start}; lower trace_start "
+                    f"or feed more batches", RuntimeWarning,
+                    stacklevel=2)
             if ckpt is not None:
                 ckpt.close()
 
@@ -305,8 +409,16 @@ class Trainer:
                             event_handler(BeginIteration(pass_id,
                                                          batch_id + k))
                         t0 = time.perf_counter()
+                        # fused groups trace as ONE step span (the whole
+                        # group is one device call; per-phase spans live
+                        # on the unfused path).  timeline-only: the
+                        # train_batch timer below covers the same window
+                        group_span = _trace.get_tracer().span(
+                            "trainer.step", cat="trainer", timer=False,
+                            pass_id=pass_id, batch=batch_id,
+                            fused=len(run))
                         if len(run) == 1:  # odd-shaped straggler: plain step
-                            with _profiler.timer("train_batch"):
+                            with group_span, _profiler.timer("train_batch"):
                                 vals = self.exe.run(
                                     self.main_program, feed=run[0],
                                     fetch_list=fetch)
@@ -316,7 +428,7 @@ class Trainer:
                                 k: np.stack([f[k] for f in run])
                                 for k in run[0]
                             }
-                            with _profiler.timer("train_batch"):
+                            with group_span, _profiler.timer("train_batch"):
                                 vals = self.exe.run_steps(
                                     self.main_program, feed=stacked,
                                     fetch_list=fetch, steps=len(run))

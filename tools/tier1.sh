@@ -65,6 +65,46 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_linttest.log >&2
     exit 1
 fi
+# tracing smoke: the end-to-end tracing engine — span runtime semantics,
+# the trainer's five step-phase spans into a valid Chrome-trace file,
+# the serving request span tree's TTFT decomposition (queue + prefill
+# within 10% of the ttft histogram), and the --bench-history gate
+# exiting non-zero on a planted failed/regressed artifact fixture
+# (docs/observability.md)
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --trace-selftest \
+        > /tmp/_t1_trace.log 2>&1; then
+    echo "TIER1 REGRESSION: trace selftest failed" >&2
+    cat /tmp/_t1_trace.log >&2
+    exit 1
+fi
+# bench-history gate: every BENCH_*/MULTICHIP_* artifact in the repo
+# must classify (failures acknowledged in tools/bench_known_failures.json
+# with a root cause, never silent) and no tracked metric may regress
+# >10% vs best-so-far — a rotted bench artifact fails CI here instead of
+# sitting on disk (the BENCH_r05 lesson)
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --bench-history \
+        > /tmp/_t1_benchhist.json 2> /tmp/_t1_benchhist.log; then
+    echo "TIER1 REGRESSION: bench-history gate failed" >&2
+    cat /tmp/_t1_benchhist.log >&2
+    cat /tmp/_t1_benchhist.json >&2
+    exit 1
+fi
+if ! python -c "
+import json
+rows = [json.loads(l) for l in open('/tmp/_t1_benchhist.json') if l.strip()]
+assert len(rows) == 1, f'expected ONE json line, got {len(rows)}'
+row = rows[0]
+for k in ('metric', 'artifacts', 'failed', 'regressions', 'ok'):
+    assert k in row, f'missing field {k}: {row}'
+assert row['ok'] is True, row
+print('bench history:', json.dumps(row))
+"; then
+    echo "TIER1 REGRESSION: bench-history emitted invalid JSON" >&2
+    cat /tmp/_t1_benchhist.json >&2
+    exit 1
+fi
 # serving smoke: the continuous-batching engine must beat the sequential
 # single-stream baseline (asserted inside --smoke) and print ONE
 # parseable JSON row with the throughput/latency/compile fields
