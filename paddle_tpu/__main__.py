@@ -1055,6 +1055,22 @@ def cmd_lint_selftest(args=None):
     return 1 if failures else 0
 
 
+def cmd_resilience_selftest(args=None):
+    """``python -m paddle_tpu --resilience-selftest``: the elastic
+    resilience engine's CI gate — a trainer subprocess on the 8-device
+    virtual CPU mesh is SIGKILLed mid-pass via ``PADDLE_TPU_FAULT``,
+    resumes from its latest loadable full-state checkpoint (params +
+    optimizer moments + RNG key + reader cursor), and must reproduce
+    the uninterrupted loss trajectory BIT-EXACT; a second child crashes
+    DURING checkpoint publish (between the two renames) and the torn
+    checkpoint must still load via the ``.old`` fallback, train-state
+    sidecar included.  The parent spawns the jax children and never
+    initializes a backend itself (docs/resilience.md)."""
+    from .resilience.selftest import run_selftest
+
+    return run_selftest()
+
+
 def main(argv=None):
     from .flags import init_flags
 
@@ -1070,6 +1086,8 @@ def main(argv=None):
         return cmd_lint_selftest()
     if "--trace-selftest" in argv:
         return cmd_trace_selftest()
+    if "--resilience-selftest" in argv:
+        return cmd_resilience_selftest()
     if "--bench-history" in argv:
         return cmd_bench_history(argv)
     if "--lint" in argv:

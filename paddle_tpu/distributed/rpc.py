@@ -83,7 +83,12 @@ class Server:
 
 
 class Client:
-    """Reconnecting RPC client (go/connection/conn.go analog)."""
+    """Reconnecting RPC client (go/connection/conn.go analog).
+
+    Dial retries use the shared jittered-exponential backoff policy
+    (``resilience.retry.Backoff``, seeded from ``retry_interval``) so a
+    fleet of trainers re-dialing a restarted pserver never thunders in
+    lockstep."""
 
     def __init__(self, endpoint, timeout=30.0, retry_interval=0.2):
         host, port = endpoint.rsplit(":", 1)
@@ -94,7 +99,12 @@ class Client:
         self._lock = threading.Lock()
 
     def _connect(self):
+        from ..resilience.retry import Backoff
+
         deadline = time.time() + self.timeout
+        backoff = iter(Backoff(base=self.retry_interval, factor=2.0,
+                               max_delay=max(self.retry_interval, 2.0),
+                               jitter=0.25))
         while True:
             try:
                 s = socket.create_connection(self.addr, timeout=self.timeout)
@@ -103,7 +113,7 @@ class Client:
             except OSError:
                 if time.time() > deadline:
                     raise
-                time.sleep(self.retry_interval)
+                time.sleep(next(backoff))
 
     def call(self, method, *args, **kwargs):
         with self._lock:

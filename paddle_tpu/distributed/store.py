@@ -72,11 +72,21 @@ class FileStore:
         return os.path.join(self.root, key.replace("/", "__"))
 
     def put(self, key, value, ttl=None):
+        from ..resilience.retry import retry_call
+
         meta = {"value": value, "expires": time.time() + ttl if ttl else None}
         tmp = self._path(key) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp, self._path(key))
+
+        def write():
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, self._path(key))
+
+        # coordination writes ride NFS in multi-host runs: absorb
+        # transient IO failures with the shared jittered backoff instead
+        # of dropping a heartbeat (a missed TTL refresh deregisters the
+        # service and the master re-dispatches its tasks)
+        retry_call(write, retries=3, retry_on=(OSError,))
 
     def get(self, key, default=None):
         try:

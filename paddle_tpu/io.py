@@ -95,11 +95,16 @@ def _host_snapshot(val):
                         jax.process_count(), jax.process_index())
 
 
-def _write_snapshot(dirname, snap):
+def _write_snapshot(dirname, snap, extra_state=None):
     """Write a {name: ndarray | _ShardedSnap} snapshot as one .npy per
     dense tensor + one .shard<p>.npz per process for partitioned tensors,
     with CRC manifests — THE on-disk checkpoint format (shared by
     save_vars and AsyncCheckpointer so the two writers cannot drift).
+    ``extra_state`` (a dict) upgrades the snapshot to a FULL-state
+    checkpoint: it is written as the ``resilience.checkpoint`` train-state
+    sidecar BEFORE the data files and completion markers, so a complete
+    checkpoint always carries it (process 0 writes it; the state — RNG
+    key, reader cursor, counters — is identical on every process).
 
     Multi-process protocol: every process calls this with the same var
     set; process 0 writes the dense files + the main manifest, every
@@ -137,6 +142,10 @@ def _write_snapshot(dirname, snap):
             f.write("begun")
     elif os.path.exists(marker):
         os.remove(marker)  # single-proc overwrite: invalidate first
+    if extra_state is not None and proc == 0:
+        from .resilience import checkpoint as _resil_ckpt
+
+        _resil_ckpt.save_train_state(dirname, extra_state)
     manifest = {"__nprocs__": nprocs}
     shard_sidecar = {}
     for name, arr in snap.items():
@@ -244,7 +253,45 @@ def _load_sharded(dirname, name, meta, current):
     return out
 
 
-def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+def _record_ckpt_telemetry(dirname, t0):
+    """checkpoint.save_ms / checkpoint.bytes histograms (+ last-value
+    gauges the trainer JSONL reads) for one finished checkpoint write.
+    Best-effort: telemetry must never fail a save."""
+    import time
+
+    try:
+        from .observability import metrics as _obs
+        from .observability import trace as _trace
+
+        ms = (time.perf_counter() - t0) * 1e3
+        nbytes = 0
+        for root, _dirs, files in os.walk(dirname):
+            for f in files:
+                try:
+                    nbytes += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass
+        reg = _obs.get_registry()
+        reg.counter("checkpoint.saves",
+                    help="checkpoints written to disk").inc()
+        reg.histogram("checkpoint.save_ms",
+                      help="wall ms per checkpoint write (worker thread "
+                           "for async saves)").observe(ms)
+        reg.histogram("checkpoint.bytes",
+                      help="bytes per checkpoint on disk").observe(nbytes)
+        reg.gauge("checkpoint.last_save_ms").set(ms)
+        reg.gauge("checkpoint.last_bytes").set(nbytes)
+        _trace.get_tracer().instant(
+            "checkpoint.saved", cat="resilience", dir=dirname,
+            ms=round(ms, 2), bytes=nbytes)
+    except Exception:
+        pass
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              extra_state=None):
+    import time
+
     program = main_program or default_main_program()
     scope = global_scope()
     if vars is None:
@@ -255,7 +302,9 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
         if val is None:
             continue
         snap[var.name] = _host_snapshot(val)
-    _write_snapshot(dirname, snap)
+    t0 = time.perf_counter()
+    _write_snapshot(dirname, snap, extra_state=extra_state)
+    _record_ckpt_telemetry(dirname, t0)
 
 
 def save_params(executor, dirname, main_program=None):
@@ -267,6 +316,16 @@ def save_params(executor, dirname, main_program=None):
 
 def save_persistables(executor, dirname, main_program=None):
     return save_vars(executor, dirname, main_program, predicate=_is_persistable)
+
+
+def save_checkpoint(executor, dirname, main_program=None, train_state=None):
+    """Synchronous FULL-state checkpoint: the persistables snapshot plus
+    the ``resilience.checkpoint`` train-state sidecar (RNG key, reader
+    cursor, pass/step counters) in one crash-detectable directory.
+    ``load_persistables`` + ``resilience.load_train_state`` restore it.
+    The async analog is ``AsyncCheckpointer.save(..., extra_state=...)``."""
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, extra_state=train_state)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
@@ -392,9 +451,9 @@ class AsyncCheckpointer:
             if item is None:
                 self._q.task_done()
                 return
-            dirname, snap = item
+            dirname, snap, extra_state = item
             try:
-                self._write(dirname, snap)
+                self._write(dirname, snap, extra_state)
             except Exception as e:  # surfaced on next save()/close()
                 self._errors.append(e)
             finally:
@@ -403,16 +462,31 @@ class AsyncCheckpointer:
                 self._q.task_done()
 
     @staticmethod
-    def _write(dirname, snap):
+    def _write(dirname, snap, extra_state=None):
         import shutil
+        import time
 
+        from .resilience import faults as _faults
+        from .resilience import retry as _retry
+
+        def write_to(target):
+            # transient-IO injection point lives INSIDE the retried call,
+            # so an injected (or real) flaky write is absorbed by the
+            # jittered backoff instead of failing the checkpoint
+            _faults.maybe_fault("ckpt.write")
+            _write_snapshot(target, snap, extra_state=extra_state)
+
+        t0 = time.perf_counter()
         multiproc = _multiproc_ids()[1] > 1
         if multiproc:
             # cross-process checkpoint: skip the atomic-rename publish (N
             # processes renaming the same dir would race); the checkpoint
             # counts as published only after the caller's barrier
-            # (wait() + a collective — tests/multihost_runner.py pattern)
-            _write_snapshot(dirname, snap)
+            # (wait() + a collective — tests/multihost_runner.py pattern).
+            # No retry either: a half-written write-once dir cannot be
+            # retried into (the begun-sentinel protocol forbids it).
+            write_to(dirname)
+            _record_ckpt_telemetry(dirname, t0)
             return
         tmp = dirname + ".tmp"
         if os.path.exists(tmp):  # leftovers from a crashed prior run
@@ -422,7 +496,12 @@ class AsyncCheckpointer:
             # crashed between the two publish renames last run: the .old
             # copy is the only good checkpoint — restore it first
             os.replace(old, dirname)
-        _write_snapshot(tmp, snap)
+        def write_tmp():
+            if os.path.exists(tmp):  # partial files from a failed try
+                shutil.rmtree(tmp)
+            write_to(tmp)
+
+        _retry.retry_call(write_tmp, retries=3, retry_on=(OSError,))
         # crash-safe publish: some valid checkpoint is always reachable —
         # dirname, or (between the two renames) dirname + ".old", which
         # load_vars falls back to.
@@ -430,18 +509,28 @@ class AsyncCheckpointer:
             shutil.rmtree(old)
         if os.path.exists(dirname):
             os.replace(dirname, old)
+        # the torn window the ckpt_crash fault targets: dirname is gone,
+        # tmp holds the new snapshot, .old holds the last good one
+        _faults.maybe_fault("ckpt.publish")
         os.replace(tmp, dirname)
         if os.path.exists(old):
             shutil.rmtree(old)
+        _record_ckpt_telemetry(dirname, t0)
 
     def _raise_pending(self):
         if self._errors:
             err, self._errors = self._errors, []  # atomic swap, no lost errors
             raise RuntimeError(f"async checkpoint write(s) failed: {err}")
 
-    def save(self, dirname, main_program=None, scope=None):
+    def save(self, dirname, main_program=None, scope=None,
+             extra_state=None):
         """Snapshot now, write in the background.  Blocks only if
         ``max_pending`` earlier checkpoints are still being written.
+
+        ``extra_state`` (a dict, e.g. the trainer's RNG/reader/step
+        state) is snapshotted to host numpy HERE — synchronously, so it
+        is consistent with the persistables snapshot — and written as
+        the full-state train-state sidecar by the worker.
 
         Multi-process jobs must save each step to a FRESH directory
         (write-once protocol); reusing one raises here, synchronously,
@@ -471,7 +560,20 @@ class AsyncCheckpointer:
             if val is None:
                 continue
             snap[var.name] = _host_snapshot(val)
-        self._q.put((dirname, snap))
+        if extra_state is not None:
+            import copy
+
+            # device arrays -> host numpy, then a DEEP copy: the worker
+            # pickles the sidecar later, and a nested live reference
+            # (e.g. a reader's underlying cursor dict) mutated by further
+            # training would capture a FUTURE state — the snapshot must
+            # be consistent with the persistables taken here
+            extra_state = copy.deepcopy({
+                k: (np.asarray(v) if hasattr(v, "dtype")
+                    or hasattr(v, "__array__") else v)
+                for k, v in extra_state.items()
+            })
+        self._q.put((dirname, snap, extra_state))
 
     def wait(self):
         """Block until all queued checkpoints are on disk."""

@@ -127,10 +127,27 @@ class MetricsReporter:
                 lint_findings=sc.get("lint_findings"),
                 lint_errors=sc.get("lint_errors"),
                 lint_checks=sc.get("lint_checks"),
+                # resilience spine (docs/resilience.md): checkpoint
+                # overhead + resume lineage, so bench history can track
+                # what checkpointing costs the step loop.  None until
+                # the first save/resume of the process.
+                checkpoint_save_ms=self._resil_value(
+                    "checkpoint.last_save_ms"),
+                checkpoint_bytes=self._resil_value(
+                    "checkpoint.last_bytes"),
+                checkpoint_saves=self._resil_value("checkpoint.saves"),
+                resume_count=self._resil_value("executor.resume_count"),
             )
         if self.log_every_n and ev.batch_id % self.log_every_n == 0:
             self._print(self._summary_line(ev, wall, throughput, mfu_v,
                                            compile_count))
+
+    @staticmethod
+    def _resil_value(name):
+        """A checkpoint/resume metric from the GLOBAL registry (io and
+        the trainer report there), or None before its first update."""
+        m = _metrics.get_registry().get(name)
+        return None if m is None else getattr(m, "value", None)
 
     def _summary_line(self, ev, wall, throughput, mfu_v, compile_count):
         parts = [f"[pass {ev.pass_id} batch {ev.batch_id}]",

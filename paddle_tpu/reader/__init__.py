@@ -18,11 +18,14 @@ from .decorator import (
     xmap_readers,
     batch,
     prefetch_to_device,
+    resumable,
+    ResumableReader,
 )
 from . import creator
 from . import provider
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-    "xmap_readers", "batch", "prefetch_to_device", "creator", "provider",
+    "xmap_readers", "batch", "prefetch_to_device", "resumable",
+    "ResumableReader", "creator", "provider",
 ]

@@ -7,7 +7,8 @@ import threading
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-    "xmap_readers", "batch", "prefetch_to_device",
+    "xmap_readers", "batch", "prefetch_to_device", "resumable",
+    "ResumableReader",
 ]
 
 
@@ -193,6 +194,89 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 yield pending[i]
 
     return data_reader
+
+
+class ResumableReader:
+    """Position-tracking reader wrapper — the input-pipeline half of a
+    full-state checkpoint (``resilience.checkpoint``).
+
+    Wraps a reader *factory* (a callable returning an iterable, the v2
+    convention).  Each iteration counts the items it hands out
+    (``items``) and completed iterations (``epochs``); ``state()``
+    snapshots the cursor and ``set_state()`` arms the NEXT iteration to
+    resume from it.
+
+    Two resume strategies, picked automatically:
+
+    * if the underlying factory object carries its own
+      ``state()``/``set_state()`` pair (e.g. a file reader snapshotting
+      a byte offset), it is delegated to — O(1) resume;
+    * otherwise the next iteration FAST-FORWARDS by re-drawing and
+      discarding ``items`` leading items — correct for any
+      deterministic reader, O(position) in reader work but zero
+      training compute.
+
+        r = resumable(my_batched_reader)
+        for b in r():
+            train(b)                 # killed here...
+        ckpt["reader_state"] = r.state()
+        # ...later, a fresh process:
+        r = resumable(my_batched_reader)
+        r.set_state(ckpt["reader_state"])
+        for b in r():                # continues at the next unseen batch
+            train(b)
+    """
+
+    def __init__(self, reader):
+        self._factory = reader
+        self._skip = 0   # items the next iteration fast-forwards past
+        self._base = 0   # position already restored inside the factory
+        self.items = 0   # current-epoch position (incl. restored items)
+        self.epochs = 0  # completed iterations
+
+    def state(self):
+        """Snapshot the cursor: the position count, plus the underlying
+        factory's own ``state()`` when it has one."""
+        out = {"items": self.items, "epochs": self.epochs}
+        if hasattr(self._factory, "state"):
+            out["underlying"] = self._factory.state()
+        return out
+
+    def set_state(self, state):
+        """Arm the next iteration to resume from ``state`` (a dict from
+        ``state()``, or any mapping with an ``items`` count)."""
+        if "underlying" in state and hasattr(self._factory, "set_state"):
+            self._factory.set_state(state["underlying"])
+            self._skip, self._base = 0, int(state.get("items", 0))
+        else:
+            self._skip, self._base = int(state.get("items", 0)), 0
+        self.epochs = int(state.get("epochs", 0))
+
+    def __call__(self):
+        skip, self._skip = self._skip, 0
+        base, self._base = self._base, 0
+
+        def gen():
+            it = iter(self._factory())
+            self.items = base
+            for _ in range(skip):
+                try:
+                    next(it)
+                except StopIteration:
+                    return
+                self.items += 1
+            for item in it:
+                self.items += 1
+                yield item
+            self.epochs += 1
+
+        return gen()
+
+
+def resumable(reader):
+    """Wrap a reader factory so its position can be checkpointed and
+    restored (see ``ResumableReader``)."""
+    return ResumableReader(reader)
 
 
 def batch(reader, batch_size, drop_last=True):

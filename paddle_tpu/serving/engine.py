@@ -229,6 +229,14 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({p_len}) + max_new_tokens ({max_new}) exceeds "
                 f"the slot KV capacity max_len={self.max_len}")
+        t = self._thread
+        if t is not None and not t.is_alive() and not self._stop.is_set():
+            # started driver died (supervision normally aborts first,
+            # which the _error check below catches; this closes the
+            # window where the thread is gone but the abort hasn't
+            # landed) — never queue onto a dead driver
+            raise RuntimeError(
+                "serving driver thread is dead") from self._error
         with self._qlock:
             # _error is set under _qlock in _abort, so checking it here
             # closes the submit-after-abort window (a request appended
@@ -347,32 +355,56 @@ class ServingEngine:
 
     # -- background driver ------------------------------------------------
     def start(self):
-        """Run the scheduler loop on a daemon thread until ``stop()``."""
+        """Run the scheduler loop on a daemon thread until ``stop()``.
+
+        The driver is SUPERVISED: if the thread dies for ANY reason —
+        not just a device error ``step()`` already turns into an abort,
+        but any exception escaping the loop itself (``BaseException``
+        included) — every queued and in-flight request is failed with
+        the captured exception, so ``Request.result(timeout=None)``
+        wakes instead of hanging forever and later ``submit()`` calls
+        raise immediately."""
         if self._thread is not None:
             raise RuntimeError("engine already started")
         self._stop.clear()
 
         def loop():
-            while not self._stop.is_set():
-                if self.idle:
-                    time.sleep(0.001)
-                    continue
-                try:
+            try:
+                while not self._stop.is_set():
+                    if self.idle:
+                        time.sleep(0.001)
+                        continue
                     self.step()
-                except Exception:
-                    return  # step() already aborted: waiters are woken
+            except BaseException as e:  # noqa: BLE001 — supervision:
+                # the driver is dying; step() aborts on Exception itself
+                # (self._error set), anything else must not strand the
+                # pending requests behind a silently-dead thread
+                if self._error is None:
+                    self._abort(e)
+                self._reg.counter(
+                    "serving.driver_deaths",
+                    help="serving driver threads that died (requests "
+                         "failed over, not stranded)").inc()
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="pt-serving-engine")
         self._thread.start()
 
+    def driver_alive(self):
+        """True while the background driver thread is running."""
+        t = self._thread
+        return t is not None and t.is_alive()
+
     def stop(self, drain=True):
         """Stop the background loop (``drain=True`` serves out queued and
-        active work first)."""
+        active work first; a dead or aborted driver ends the drain
+        immediately — its pending requests are already failed)."""
         if self._thread is None:
             return
         if drain:
             while not self.idle:
+                if self._error is not None or not self._thread.is_alive():
+                    break  # nothing will ever drain the rest
                 time.sleep(0.001)
         self._stop.set()
         self._thread.join()

@@ -13,11 +13,13 @@ import numpy as np
 
 from .core.executor import Executor
 from .core.program import default_main_program, default_startup_program
-from .core.scope import global_scope
+from .core.scope import RNG_VAR, global_scope
 from .data_feeder import DataFeeder
 from .observability import hardware as _hardware
 from .observability import metrics as _obs
 from .observability import trace as _trace
+from .resilience import checkpoint as _resil_ckpt
+from .resilience import faults as _faults
 from . import profiler as _profiler
 from . import io as _io
 
@@ -91,6 +93,8 @@ class Trainer:
         self._initialized = False
         self._peak_flops_cache = None
         self._global_step = 0  # StepTraceAnnotation step_num across passes
+        self._last_ckpt_step = 0  # last global step a step-checkpoint saved
+        self.last_resume = None   # train-state dict of the last resume
 
     def init_params(self):
         self.exe.run(self.startup_program)
@@ -100,7 +104,9 @@ class Trainer:
               checkpoint_dir=None, checkpoint_every_n_passes=1,
               async_checkpoint=False, prefetch=0, steps_per_call=1,
               fused_group=8, probe_samples=6, trace_dir=None,
-              trace_start=1, trace_steps=2):
+              trace_start=1, trace_steps=2,
+              checkpoint_every_n_steps=None, resume=False,
+              keep_checkpoints=3, watchdog_deadline=None):
         """``async_checkpoint=True`` writes per-pass checkpoints from a
         background thread (io.AsyncCheckpointer): training only pays the
         device->host snapshot, not serialization + disk IO.  Pending
@@ -146,7 +152,21 @@ class Trainer:
         named scopes.  ``trace_dir`` requires the unfused path: with
         ``steps_per_call != 1`` there is no per-step host boundary to
         window on (the group is one device call), so the combination
-        raises rather than silently capturing nothing."""
+        raises rather than silently capturing nothing.
+
+        Resilience (docs/resilience.md): ``checkpoint_every_n_steps=N``
+        saves a FULL-state checkpoint (persistables + RNG key + reader
+        cursor + pass/step counters, ``resilience.checkpoint`` schema) to
+        ``checkpoint_dir/step_<global_step>`` every N completed steps —
+        mid-pass, not just per-pass — keeping the ``keep_checkpoints``
+        newest.  ``resume=True`` discovers the latest loadable step
+        checkpoint (skipping torn ones, honoring the crash-publish
+        ``.old`` fallback), restores params + optimizer state + RNG +
+        reader position, and continues such that the loss trajectory is
+        BIT-EXACT vs the uninterrupted run (the ``--resilience-selftest``
+        gate).  ``watchdog_deadline=S`` supervises the step loop: a step
+        that makes no progress for S seconds trips the
+        ``resilience.watchdog_trips`` counter and a timeline instant."""
         if not self._initialized:
             self.init_params()
         event_handler = event_handler or (lambda e: None)
@@ -161,12 +181,22 @@ class Trainer:
                 "is no per-step boundary to window the XPlane capture "
                 "on (an empty trace directory would be the only "
                 "symptom)")
+        if resume and not checkpoint_dir:
+            raise ValueError("resume=True requires checkpoint_dir")
+        if checkpoint_every_n_steps and keep_checkpoints < 2:
+            # fail HERE, not 100 steps in when the first prune runs
+            raise ValueError(
+                f"keep_checkpoints must be >= 2 (the async write queue "
+                f"can hold the two newest saves in flight): "
+                f"{keep_checkpoints}")
         if steps_per_call != 1:
             return self._train_fused(reader, num_passes, event_handler,
                                      checkpoint_dir,
                                      checkpoint_every_n_passes,
                                      async_checkpoint, steps_per_call,
-                                     fused_group, probe_samples)
+                                     fused_group, probe_samples,
+                                     checkpoint_every_n_steps, resume,
+                                     keep_checkpoints, watchdog_deadline)
         if prefetch:
             from .reader import prefetch_to_device
 
@@ -185,22 +215,38 @@ class Trainer:
             checkpoint_dir and async_checkpoint) else None
         reg = _obs.get_registry()
         tracer = _trace.get_tracer()
+        start_pass, resume_skip, reader_skips = self._maybe_resume(
+            resume, checkpoint_dir, reader, num_passes)
+        wd = self._make_watchdog(watchdog_deadline)
         xplane_on = False
         xplane_done = False
         call_step = 0  # THIS call's step count: the trace_dir window is
         #                per-call (self._global_step keeps counting across
         #                train() calls for StepTraceAnnotation)
         try:
-            for pass_id in range(num_passes):
+            for pass_id in range(start_pass, num_passes):
                 event_handler(BeginPass(pass_id))
                 it = iter(batches())
                 batch_id = 0
+                if pass_id == start_pass and resume_skip:
+                    # fast-forward the resumed pass to the checkpoint's
+                    # reader cursor: a resumable reader already skips
+                    # inside its own iteration; anything else is drained
+                    # here (drawn and discarded — no training compute)
+                    if not reader_skips:
+                        for _ in range(resume_skip):
+                            try:
+                                next(it)
+                            except StopIteration:
+                                break
+                    batch_id = resume_skip
                 while True:
                     # reader/feed stall: time spent waiting for the input
                     # pipeline to produce the next batch.  With prefetch
                     # this is ~0 unless the producer can't keep up — the
                     # gauge that diagnoses input-bound runs without xprof.
                     t_wait = time.perf_counter()
+                    _faults.maybe_fault("reader.next")
                     try:
                         item = next(it)
                     except StopIteration:
@@ -214,6 +260,7 @@ class Trainer:
                     reg.counter("trainer.reader_wait_seconds_total").inc(
                         reader_wait)
                     event_handler(BeginIteration(pass_id, batch_id))
+                    fault_action = _faults.maybe_fault("trainer.step")
                     step_num = self._global_step
                     self._global_step += 1
                     if trace_dir and not xplane_on and not xplane_done \
@@ -262,6 +309,8 @@ class Trainer:
                                          cat="trainer"):
                             vals = [np.asarray(v) for v in vals]
                         cost = float(vals[0].reshape(-1)[0])
+                        if fault_action == "nan":
+                            cost = float("nan")  # injected bad gradient
                         wall = time.perf_counter() - t0
                         # opt_boundary: host-side step-boundary work after
                         # the fused fwd+bwd+optimizer device step — state
@@ -273,6 +322,15 @@ class Trainer:
                                 pass_id, batch_id, cost, metrics,
                                 reader_wait=reader_wait,
                                 **self._step_telemetry(wall, feed)))
+                    if wd is not None:
+                        wd.beat()
+                    self._step_checkpoint(
+                        ckpt, checkpoint_dir, checkpoint_every_n_steps,
+                        keep_checkpoints, pass_id, batch_id + 1,
+                        num_passes,
+                        reader_state_src=(
+                            reader if not prefetch
+                            and hasattr(reader, "state") else None))
                     call_step += 1
                     if xplane_on and \
                             call_step >= trace_start + trace_steps:
@@ -284,6 +342,8 @@ class Trainer:
                                       checkpoint_every_n_passes)
                 event_handler(EndPass(pass_id))
         finally:
+            if wd is not None:
+                wd.stop()
             if xplane_on:
                 jax.profiler.stop_trace()
             elif trace_dir and not xplane_done:
@@ -361,10 +421,16 @@ class Trainer:
 
     def _train_fused(self, reader, num_passes, event_handler, checkpoint_dir,
                      checkpoint_every_n_passes, async_checkpoint,
-                     steps_per_call, fused_group=8, probe_samples=6):
+                     steps_per_call, fused_group=8, probe_samples=6,
+                     checkpoint_every_n_steps=None, resume=False,
+                     keep_checkpoints=3, watchdog_deadline=None):
         """The steps_per_call train loop: group same-shape converted
         batches, stack them [steps, ...], one run_steps per group, unpack
-        stacked fetches back to per-batch events."""
+        stacked fetches back to per-batch events.  Step checkpoints fire
+        at group boundaries (the group is one device call, so a crossed
+        ``checkpoint_every_n_steps`` multiple saves once the group
+        lands); resume fast-forwards the resumed pass's batches before
+        grouping restarts."""
         fetch = [self.cost] + list(self.extra_fetch)
         auto = steps_per_call == "auto"
         group_n = 1 if auto else int(steps_per_call)
@@ -378,17 +444,25 @@ class Trainer:
         probe_samples = max(3, int(probe_samples))
         ckpt = _io.AsyncCheckpointer() if (
             checkpoint_dir and async_checkpoint) else None
+        start_pass, resume_skip, reader_skips = self._maybe_resume(
+            resume, checkpoint_dir, reader, num_passes)
+        wd = self._make_watchdog(watchdog_deadline)
         # auto-probe state, shared across passes: single-step timings,
         # fused-group per-batch timings (first of each is a compile)
         single_t, fused_t = [], []
         try:
-            for pass_id in range(num_passes):
+            for pass_id in range(start_pass, num_passes):
                 event_handler(BeginPass(pass_id))
-                batch_id = 0
+                batch_id = resume_skip if pass_id == start_pass else 0
+                skip = (resume_skip
+                        if pass_id == start_pass and not reader_skips
+                        else 0)
                 pending = []  # [(feed_dict, signature)]
 
-                def emit_end(batch_id, row, telemetry=None):
+                def emit_end(batch_id, row, telemetry=None, poison=False):
                     cost = float(np.asarray(row[0]).reshape(-1)[0])
+                    if poison:  # injected nan_grad fault for this batch
+                        cost = float("nan")
                     metrics = [np.asarray(v) for v in row[1:]]
                     event_handler(EndIteration(pass_id, batch_id, cost,
                                                metrics, **(telemetry or {})))
@@ -405,7 +479,10 @@ class Trainer:
                         # Begin fires BEFORE execution for every batch of
                         # the group (a fused group interleaves as
                         # Begin..Begin End..End — execution is one call)
+                        fault_actions = []
                         for k in range(len(run)):
+                            fault_actions.append(
+                                _faults.maybe_fault("trainer.step"))
                             event_handler(BeginIteration(pass_id,
                                                          batch_id + k))
                         t0 = time.perf_counter()
@@ -452,23 +529,44 @@ class Trainer:
                         telemetry = self._step_telemetry(
                             time.perf_counter() - t0, run[0],
                             n_batches=len(run))
-                        for row in rows:
-                            emit_end(batch_id, row, telemetry)
+                        for k, row in enumerate(rows):
+                            emit_end(batch_id, row, telemetry,
+                                     poison=fault_actions[k] == "nan")
                             batch_id += 1
+                        self._global_step += len(run)
+                        if wd is not None:
+                            wd.beat()
+                        self._step_checkpoint(ckpt, checkpoint_dir,
+                                              checkpoint_every_n_steps,
+                                              keep_checkpoints, pass_id,
+                                              batch_id, num_passes)
                     return batch_id
 
                 for item in reader():
+                    _faults.maybe_fault("reader.next")
+                    if skip:
+                        skip -= 1  # resumed pass: already-trained batch
+                        continue
                     feed = self.feeder.feed(item)
                     if auto and len(single_t) < probe_samples:
                         # probe phase 1: single steps (first is a compile)
+                        fault_action = _faults.maybe_fault("trainer.step")
                         event_handler(BeginIteration(pass_id, batch_id))
                         t0 = time.perf_counter()
                         vals = self.exe.run(self.main_program, feed=feed,
                                             fetch_list=fetch)
                         single_t.append(time.perf_counter() - t0)
                         emit_end(batch_id, vals,
-                                 self._step_telemetry(single_t[-1], feed))
+                                 self._step_telemetry(single_t[-1], feed),
+                                 poison=fault_action == "nan")
                         batch_id += 1
+                        self._global_step += 1
+                        if wd is not None:
+                            wd.beat()
+                        self._step_checkpoint(ckpt, checkpoint_dir,
+                                              checkpoint_every_n_steps,
+                                              keep_checkpoints, pass_id,
+                                              batch_id, num_passes)
                         if len(single_t) >= probe_samples:
                             # probe phase 2: fused groups
                             group_n = fused_group
@@ -484,6 +582,8 @@ class Trainer:
                                       checkpoint_every_n_passes)
                 event_handler(EndPass(pass_id))
         finally:
+            if wd is not None:
+                wd.stop()
             if ckpt is not None:
                 ckpt.close()
 
@@ -494,6 +594,101 @@ class Trainer:
                 ckpt.save(path, self.main_program)
             else:
                 _io.save_persistables(self.exe, path, self.main_program)
+
+    # -- resilience (docs/resilience.md) -----------------------------------
+    def _make_watchdog(self, deadline):
+        if not deadline:
+            return None
+        from .resilience.watchdog import Watchdog
+
+        return Watchdog(deadline, label="trainer.step")
+
+    def _maybe_resume(self, resume, checkpoint_dir, reader, num_passes):
+        """Restore the latest full-state checkpoint.  Returns
+        ``(start_pass, resume_skip, reader_skips)``: the pass to resume
+        in, how many of its batches are already done, and whether the
+        reader fast-forwards itself (``ResumableReader.set_state``) or
+        the caller must drain them from the iterator."""
+        if not resume:
+            return 0, 0, False
+        path = _resil_ckpt.latest_checkpoint(checkpoint_dir)
+        if path is None:
+            return 0, 0, False  # cold start: nothing to resume from
+        _io.load_persistables(self.exe, path, self.main_program)
+        st = _resil_ckpt.load_train_state(path)
+        key = st.get("rng_key")
+        if key is not None:
+            import jax.numpy as jnp
+
+            # the @RNG@ key AFTER the checkpointed step: restoring it
+            # replays the exact per-step dropout key derivation chain
+            global_scope().set(RNG_VAR, jnp.asarray(np.asarray(key)))
+        self._global_step = int(st.get("global_step", 0))
+        self._last_ckpt_step = self._global_step
+        start_pass = int(st.get("pass_id", 0))
+        resume_skip = int(st.get("step_in_pass", 0))
+        saved_passes = st.get("num_passes")
+        if saved_passes is not None and int(saved_passes) != num_passes:
+            import warnings
+
+            warnings.warn(
+                f"resuming a num_passes={saved_passes} run with "
+                f"num_passes={num_passes}: pass accounting continues "
+                f"from pass {start_pass}", RuntimeWarning, stacklevel=3)
+        reader_skips = hasattr(reader, "set_state")
+        if reader_skips:
+            reader.set_state(st.get("reader_state")
+                             or {"items": resume_skip})
+        self.last_resume = dict(st, path=path)
+        _obs.get_registry().counter(
+            "executor.resume_count",
+            help="trainer resumes from a full-state checkpoint").inc()
+        _trace.get_tracer().instant(
+            "resume", cat="resilience", path=path,
+            step=self._global_step, pass_id=start_pass)
+        return start_pass, resume_skip, reader_skips
+
+    def _step_checkpoint(self, ckpt, checkpoint_dir, every_n, keep,
+                         pass_id, batches_done, num_passes,
+                         reader_state_src=None):
+        """Full-state checkpoint at step granularity: fires when
+        ``global_step`` crossed a multiple of ``every_n`` since the last
+        save (a fused group can cross mid-group; the save lands at the
+        group boundary).  ``reader_state_src``: a position-tracking
+        reader (``reader.resumable``) whose ``state()`` snapshot — incl.
+        any O(1) underlying cursor — replaces the plain item count;
+        only passed where handed-out == trained (the unfused,
+        non-prefetch loop: prefetch producers and fused pending queues
+        run AHEAD of training, so their counts would overshoot)."""
+        if not (checkpoint_dir and every_n):
+            return
+        if (self._global_step // every_n
+                <= self._last_ckpt_step // every_n):
+            return
+        if reader_state_src is not None:
+            reader_state = reader_state_src.state()
+        else:
+            reader_state = {"items": batches_done}
+        rng = global_scope().find_var(RNG_VAR)
+        state = {
+            "global_step": self._global_step,
+            "pass_id": pass_id,
+            "step_in_pass": batches_done,
+            "rng_key": None if rng is None else np.asarray(rng),
+            "rng_seed": self.main_program.random_seed,
+            "reader_state": reader_state,
+            "num_passes": num_passes,
+        }
+        path = _resil_ckpt.step_dir(checkpoint_dir, self._global_step)
+        if ckpt is not None:
+            ckpt.save(path, self.main_program, extra_state=state)
+        else:
+            _io.save_checkpoint(self.exe, path, self.main_program,
+                                train_state=state)
+        self._last_ckpt_step = self._global_step
+        # retention is safe against the async queue: with max_pending=2
+        # only the two newest saves can be in flight, and prune keeps >= 2
+        _resil_ckpt.prune_checkpoints(checkpoint_dir, keep=keep)
 
     def test(self, reader, test_program=None, fetch_list=None):
         """Average fetched values over a test reader (reference

@@ -17,6 +17,20 @@ an array throws — io._ShardedSnap per-process shard files are the fix):
 * ``ckpt_resume`` — fresh processes: startup, load_persistables (each
                     process reads only ITS shard file), train 2 steps,
                     print final.  Must equal both runs above bit-for-bit.
+
+The ``ckpt_resume_midpass`` family (ISSUE 8, ROADMAP item 4's gate at
+multi-host scale) upgrades this to kill-and-resume with FULL state
+(``io.save_checkpoint`` + the resilience train-state sidecar carrying
+the RNG key and step counter):
+
+* ``ckpt_mid_ref``    — 4 steps straight through, print final state;
+* ``ckpt_mid_kill``   — 2 steps, full-state checkpoint (per-process
+                        shard files + proc-0 train-state), barrier, then
+                        SIGKILL OWN PID — both ranks die mid-pass, no
+                        unwinding (the parent expects rc == -SIGKILL);
+* ``ckpt_mid_resume`` — fresh processes restore persistables + train
+                        state + RNG, run the remaining 2 steps, print
+                        final.  Must equal ``ckpt_mid_ref`` bit-for-bit.
 """
 
 import os
@@ -115,7 +129,37 @@ def _ckpt_mode(mode, ckpt_dir, coordinator, nproc, pid):
         multihost_utils.sync_global_devices("ckpt")
 
     pnames = sorted(p.name for p in main_p.all_parameters())
-    if mode == "ckpt_ref":
+    if mode == "ckpt_mid_ref":
+        for _ in range(4):
+            loss = step()
+    elif mode == "ckpt_mid_kill":
+        import signal
+
+        import paddle_tpu.io as io
+
+        for _ in range(2):
+            loss = step()
+        with pt.core.scope.scope_guard(scope):
+            io.save_checkpoint(exe, ckpt_dir, main_p, train_state={
+                "global_step": 2, "pass_id": 0, "step_in_pass": 2,
+                "rng_key": np.asarray(scope.get(pt.core.scope.RNG_VAR)),
+            })
+        barrier()  # every rank's shard files + markers are on disk
+        print(f"MULTIHOST_KILL_READY {pid}", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif mode == "ckpt_mid_resume":
+        import paddle_tpu.io as io
+        from paddle_tpu.resilience import checkpoint as rckpt
+
+        with pt.core.scope.scope_guard(scope):
+            io.load_persistables(exe, ckpt_dir, main_p)
+        st = rckpt.load_train_state(ckpt_dir)
+        assert st["global_step"] == 2, st
+        scope.set(pt.core.scope.RNG_VAR,
+                  jnp.asarray(np.asarray(st["rng_key"])))
+        for _ in range(4 - st["global_step"]):
+            loss = step()
+    elif mode == "ckpt_ref":
         for _ in range(3):
             loss = step()
     elif mode == "ckpt_save":
@@ -136,7 +180,15 @@ def _ckpt_mode(mode, ckpt_dir, coordinator, nproc, pid):
             loss = step()
     else:
         raise SystemExit(f"unknown mode {mode}")
-    digest = _state_digest(scope, pnames)
+    names = pnames
+    if mode.startswith("ckpt_mid"):
+        # the midpass gate digests EVERY persistable — momentum state
+        # included, so a resume that lost optimizer moments cannot pass
+        # on params alone
+        names = sorted(
+            v.name for v in main_p.global_block().vars.values()
+            if v.persistable and scope.find_var(v.name) is not None)
+    digest = _state_digest(scope, names)
     print(f"MULTIHOST_CKPT_OK {pid} loss={loss:.8f} state={digest}",
           flush=True)
 

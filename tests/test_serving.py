@@ -3,6 +3,8 @@ lifecycle, EOS eviction, bucketed-prefill compile bound, token-identity
 vs the single-stream decode, and serving.* metrics exposure.  All on the
 CPU mesh (conftest), tiny model shapes."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -219,6 +221,58 @@ def test_engine_abort_fails_pending_requests(params):
         assert eng.stats()["serving.aborted"] == 1
     finally:
         eng.stop()
+
+
+def test_driver_thread_death_fails_pending_requests(params):
+    """ISSUE 8 satellite: a driver thread that DIES (an exception
+    ``step()`` does not turn into an abort — here a ``BaseException``
+    escaping the loop) must fail every pending/queued request with the
+    captured exception so ``result(timeout=None)`` returns instead of
+    hanging forever, and ``submit()`` after the death raises
+    immediately."""
+    import threading
+
+    eng = _engine(params)
+
+    class DriverKilled(BaseException):  # escapes step()'s Exception catch
+        pass
+
+    def boom():
+        raise DriverKilled("driver thread killed")
+
+    eng._admit = boom
+    eng.start()
+    try:
+        req = eng.submit(np.asarray([1, 2, 3]), max_new_tokens=4)
+        # result(timeout=None) is the hang the supervision removes: run
+        # it on a side thread with a bounded join so a regression fails
+        # the test instead of wedging the suite
+        got = {}
+
+        def wait_forever():
+            try:
+                got["val"] = req.result(timeout=None)
+            except BaseException as e:  # noqa: BLE001
+                got["err"] = e
+
+        t = threading.Thread(target=wait_forever, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        assert not t.is_alive(), \
+            "result(timeout=None) still hangs after driver death"
+        assert isinstance(got.get("err"), RuntimeError)
+        assert isinstance(req.error, DriverKilled)
+        # the dead driver is observable and rejects new work
+        for _ in range(200):
+            if not eng.driver_alive():
+                break
+            time.sleep(0.01)
+        assert not eng.driver_alive()
+        with pytest.raises(RuntimeError):
+            eng.submit([1], max_new_tokens=1)
+        assert _obs.get_registry().value("serving.driver_deaths") == 1
+    finally:
+        eng.stop()  # must not hang on the drain either
 
 
 def test_background_thread_driver(params):

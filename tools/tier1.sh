@@ -78,6 +78,20 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_trace.log >&2
     exit 1
 fi
+# resilience smoke: the elastic resilience engine — a trainer subprocess
+# on the 8-device virtual CPU mesh SIGKILLed mid-pass (PADDLE_TPU_FAULT)
+# resumes from its latest loadable full-state checkpoint (params +
+# optimizer state + RNG + reader cursor) and reproduces the
+# uninterrupted loss trajectory bit-exact, and a crash injected DURING
+# checkpoint publish still leaves a loadable checkpoint via the .old
+# fallback (docs/resilience.md)
+if ! timeout -k 10 900 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --resilience-selftest \
+        > /tmp/_t1_resilience.log 2>&1; then
+    echo "TIER1 REGRESSION: resilience selftest failed" >&2
+    cat /tmp/_t1_resilience.log >&2
+    exit 1
+fi
 # bench-history gate: every BENCH_*/MULTICHIP_* artifact in the repo
 # must classify (failures acknowledged in tools/bench_known_failures.json
 # with a root cause, never silent) and no tracked metric may regress
