@@ -59,6 +59,7 @@ from .data_feeder import DataFeeder
 from . import parallel
 from . import observability
 from . import analysis
+from . import tune
 from . import resilience
 from . import serving
 from . import profiler

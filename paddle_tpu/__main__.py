@@ -558,6 +558,12 @@ def cmd_bench_history(argv):
     summary, rows = bh.history(root, threshold=args.threshold,
                                known_failures=known)
     print(bh.format_table(rows), file=sys.stderr)
+    for art, why in sorted(summary.get("resolved", {}).items()):
+        print(f"RESOLVED: {art}: {why}", file=sys.stderr)
+    for k in summary.get("stale_acks", []):
+        print(f"WARNING: stale ack {k!r} in {kf or 'known-failures'}: "
+              f"the acknowledged defect no longer exists — delete the "
+              f"entry", file=sys.stderr)
     for r in summary["regressions"]:
         ack = (" (acknowledged)"
                if f"{r['artifact']}:{r['metric']}" in known else "")
@@ -1055,6 +1061,21 @@ def cmd_lint_selftest(args=None):
     return 1 if failures else 0
 
 
+def cmd_tune_selftest(args=None):
+    """``python -m paddle_tpu --tune-selftest``: the autotune engine's
+    CI gate, CPU-only — a miniature measured schedule search over a toy
+    transformer (the HBM preflight rejects over-budget candidates from
+    compiled cost analysis alone, the winner beats the worst measured
+    candidate), a second invocation is a pure cache hit with zero
+    recompiles, ``PADDLE_TPU_TUNE=0`` is bit-exact vs the untuned
+    defaults, and the t=16k flagship static prune rejects the BENCH_r05
+    config while selecting a schedule with headroom
+    (docs/autotune.md).  Wired into tools/tier1.sh."""
+    from .tune.selftest import run_selftest
+
+    return run_selftest()
+
+
 def cmd_resilience_selftest(args=None):
     """``python -m paddle_tpu --resilience-selftest``: the elastic
     resilience engine's CI gate — a trainer subprocess on the 8-device
@@ -1088,6 +1109,8 @@ def main(argv=None):
         return cmd_trace_selftest()
     if "--resilience-selftest" in argv:
         return cmd_resilience_selftest()
+    if "--tune-selftest" in argv:
+        return cmd_tune_selftest()
     if "--bench-history" in argv:
         return cmd_bench_history(argv)
     if "--lint" in argv:

@@ -445,6 +445,17 @@ class Executor:
                 ).set_max(comm["collective_bytes"])
         if self.last_accum_plan is not None:
             cost["accum_comm"] = dict(self.last_accum_plan)
+        try:
+            # autotune traffic snapshot (tune.cache_hits/misses/searches)
+            # — how a trainer JSONL/bench row shows whether this compile
+            # ran on tuned or default schedules (docs/autotune.md)
+            from ..tune import tune_stats
+
+            ts = tune_stats()
+            if ts:
+                cost["tune"] = ts
+        except Exception:  # noqa: BLE001 — telemetry must never block
+            pass
         from ..analysis import compile_findings, lint_enabled
 
         if program is not None and lint_enabled():

@@ -916,18 +916,25 @@ def softmax(x, name=None):
     return out
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=None,
+                    block_k=None, name=None):
     """Fused blockwise attention (Pallas TPU kernel,
     ops/pallas_attention.py).  q [b, t_q, h, d], k/v [b, t_k, h, d] ->
-    [b, t_q, h, d]."""
+    [b, t_q, h, d].  ``block_q``/``block_k`` tune the kernel tiles
+    (kernel defaults when omitted)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_tmp_variable(q.dtype, q.shape)
+    attrs = {"causal": bool(causal),
+             "sm_scale": 0.0 if sm_scale is None else float(sm_scale)}
+    if block_q:
+        attrs["block_q"] = int(block_q)
+    if block_k:
+        attrs["block_k"] = int(block_k)
     helper.append_op(
         type="flash_attention",
         inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
         outputs={"Out": [out.name]},
-        attrs={"causal": bool(causal),
-               "sm_scale": 0.0 if sm_scale is None else float(sm_scale)},
+        attrs=attrs,
     )
     return out
 
@@ -935,7 +942,7 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
 def multi_head_attention(queries, keys, values, d_model, n_head,
                          dropout_rate=0.0, causal=False, is_test=False,
                          param_attr=None, block_q=None, block_k=None,
-                         name=None):
+                         packed=None, name=None):
     """Multi-head attention block: QKV projections -> fused flash
     attention (Pallas TPU kernel) -> output projection.
 
@@ -943,6 +950,15 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
     (``trainer_config_helpers/networks.py simple_attention``); this is the
     modern multi-head form with the O(t) HBM-traffic kernel.  Inputs are
     ``[batch, time, dim]``; ``d_model`` must divide by ``n_head``.
+
+    Kernel geometry is TUNABLE (docs/autotune.md): when the caller
+    passes no explicit ``block_q``/``block_k``/``packed``, the autotune
+    cache is consulted for this shape's measured winner
+    (``tune.attention_config``; ``PADDLE_TPU_TUNE=0`` kills the lookup
+    and a cache miss keeps today's defaults).  Explicit arguments always
+    win.  ``packed`` forces the head routing: True = the transpose-free
+    packed kernel (geometry permitting), False = the 4-D path, None =
+    tuned/auto.
     """
     if d_model % n_head:
         raise ValueError(f"d_model {d_model} not divisible by n_head {n_head}")
@@ -964,6 +980,26 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
     b, tq = queries.shape[0], queries.shape[1]
     tk = keys.shape[1]
     dh = d_model // n_head
+    if block_q is None and block_k is None and causal and tq == tk:
+        # no explicit geometry: consult the autotune cache for this
+        # shape's measured winner (None on miss/kill-switch — defaults)
+        from ..tune import attention_config
+
+        tuned = attention_config(tq, dh, n_head, queries.dtype,
+                                 causal=causal)
+        if tuned:
+            block_q = tuned.get("block_q")
+            block_k = tuned.get("block_k")
+            if packed is None:
+                packed = tuned.get("packed")
+            if tuned.get("diag_w"):
+                # the winner was MEASURED at this sub-tile width; the
+                # kernels read the module global at trace time
+                # (process-wide — last tuned build wins; the
+                # PADDLE_TPU_DIAG_W env pin beats the cache)
+                from ..ops.pallas_attention import apply_tuned_diag_w
+
+                apply_tuned_diag_w(tuned["diag_w"])
     q = fc(queries, d_model, num_flatten_dims=2, param_attr=_proj_attr("q"),
            name=None if name is None else name + "_q")
     k = fc(keys, d_model, num_flatten_dims=2, param_attr=_proj_attr("k"),
@@ -972,7 +1008,10 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
            name=None if name is None else name + "_v")
     from ..ops.pallas_attention import packed_sub_heads
 
-    if packed_sub_heads(n_head, dh) is not None:
+    use_packed = packed_sub_heads(n_head, dh) is not None
+    if packed is not None:
+        use_packed = use_packed and bool(packed)
+    if use_packed:
         # packable head geometry (d_head % 128 == 0, d_head == 64 with
         # even n_head — two heads per lane slice — or n_head == 1): the
         # packed kernel takes the projection outputs as-is and no head
@@ -986,7 +1025,8 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
         kh = reshape(k, [b, tk, n_head, dh])
         vh = reshape(v, [b, tk, n_head, dh])
         ctx = flash_attention(qh, kh, vh, causal=causal,
-                              sm_scale=1.0 / float(dh) ** 0.5)
+                              sm_scale=1.0 / float(dh) ** 0.5,
+                              block_q=block_q, block_k=block_k)
         ctx = reshape(ctx, [b, tq, d_model])
     out = fc(ctx, d_model, num_flatten_dims=2, param_attr=_proj_attr("out"),
              name=None if name is None else name + "_out")

@@ -181,16 +181,32 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
     ``pinned_host`` memory space (CPU) the same checkpoint structure
     runs with the block inputs left in device memory.
 
+    ``policy="auto"``: consult the autotune cache
+    (``paddle_tpu.tune``, docs/autotune.md) for this program's flash
+    workload key and apply the MEASURED winning policy; a cache miss
+    (or ``PADDLE_TPU_TUNE=0``) falls back to ``selective`` — today's
+    default.  A tuned winner of ``"none"`` leaves the program unmarked
+    (no remat at all was the measured-fastest schedule that fit).
+
     Returns the segment list ``[(start, end, wrapped), ...]`` tiling the
     forward prefix."""
     from .core.program import default_main_program
 
     program = input_program or default_main_program()
+    if policy == "auto":
+        from .tune import program_schedule_config
+
+        cfg = program_schedule_config(program) or {}
+        policy = cfg.get("policy") or "selective"
+        if policy == "none":
+            program._offload = False
+            program._remat_segments = []
+            return []
     block = program.global_block()
     if policy not in ("selective", "compact", "full", "offload"):
         raise ValueError(
             f"memory_optimize policy must be 'selective', 'compact', "
-            f"'full' or 'offload', got {policy!r}")
+            f"'full', 'offload' or 'auto', got {policy!r}")
     # the offload flag rides on the program (the Executor's scan body
     # reads it); segmentation below is exactly selective's
     program._offload = policy == "offload"

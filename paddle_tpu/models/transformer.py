@@ -16,13 +16,14 @@ from ..layers import tensor as ltensor
 
 
 def transformer_block(x, d_model, n_head, d_ff, dropout_rate, is_test,
-                      name, attn_block_q=None, attn_block_k=None):
+                      name, attn_block_q=None, attn_block_k=None,
+                      attn_packed=None):
     """Pre-LN block: x + MHA(LN(x)) then x + FFN(LN(x))."""
     ln1 = layers.layer_norm(x, begin_norm_axis=2, name=name + "_ln1")
     att = layers.multi_head_attention(
         ln1, ln1, ln1, d_model=d_model, n_head=n_head,
         dropout_rate=dropout_rate, causal=True, is_test=is_test,
-        block_q=attn_block_q, block_k=attn_block_k,
+        block_q=attn_block_q, block_k=attn_block_k, packed=attn_packed,
         name=name + "_att")
     x = x + att
     ln2 = layers.layer_norm(x, begin_norm_axis=2, name=name + "_ln2")
@@ -36,7 +37,8 @@ def transformer_block(x, d_model, n_head, d_ff, dropout_rate, is_test,
 
 def gpt_trunk(tokens, vocab_size, n_layer=4, n_head=8, d_model=256,
               d_ff=None, max_len=128, dropout_rate=0.1, is_test=False,
-              dtype="bfloat16", attn_block_q=None, attn_block_k=None):
+              dtype="bfloat16", attn_block_q=None, attn_block_k=None,
+              attn_packed=None):
     """Causal LM trunk up to the final layer norm: [batch, time, d_model]
     hidden states in ``dtype`` (the head is attached by the caller).
     ``attn_block_q``/``attn_block_k`` tune the flash-attention kernel tile
@@ -56,18 +58,20 @@ def gpt_trunk(tokens, vocab_size, n_layer=4, n_head=8, d_model=256,
         x = transformer_block(x, d_model, n_head, d_ff, dropout_rate,
                               is_test, name=f"block{i}",
                               attn_block_q=attn_block_q,
-                              attn_block_k=attn_block_k)
+                              attn_block_k=attn_block_k,
+                              attn_packed=attn_packed)
     return layers.layer_norm(x, begin_norm_axis=2, name="ln_f")
 
 
 def gpt(tokens, vocab_size, n_layer=4, n_head=8, d_model=256, d_ff=None,
         max_len=128, dropout_rate=0.1, is_test=False, dtype="bfloat16",
-        attn_block_q=None, attn_block_k=None):
+        attn_block_q=None, attn_block_k=None, attn_packed=None):
     """Causal LM trunk: returns [batch, time, vocab] logits (float32)."""
     x = gpt_trunk(tokens, vocab_size, n_layer=n_layer, n_head=n_head,
                   d_model=d_model, d_ff=d_ff, max_len=max_len,
                   dropout_rate=dropout_rate, is_test=is_test, dtype=dtype,
-                  attn_block_q=attn_block_q, attn_block_k=attn_block_k)
+                  attn_block_q=attn_block_q, attn_block_k=attn_block_k,
+                  attn_packed=attn_packed)
     logits = layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False,
                        name="lm_head")
     return ltensor.cast(logits, "float32")
@@ -309,7 +313,7 @@ def generate(params, prompt, max_len, n_layer, n_head, d_model,
 def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
           max_len=128, dropout_rate=0.1, is_test=False,
           learning_rate=1e-3, dtype="bfloat16", fused_head=False,
-          attn_block_q=None, attn_block_k=None):
+          attn_block_q=None, attn_block_k=None, attn_packed=None):
     """Next-token-prediction training program.
 
     Feeds: tokens [batch, max_len] int64, labels [batch, max_len] int64
@@ -336,7 +340,8 @@ def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
                       d_model=d_model, d_ff=d_ff, max_len=max_len,
                       dropout_rate=dropout_rate, is_test=is_test,
                       dtype=dtype, attn_block_q=attn_block_q,
-                      attn_block_k=attn_block_k)
+                      attn_block_k=attn_block_k,
+                      attn_packed=attn_packed)
         loss = layers.fused_softmax_ce_head(x, safe2d, vocab_size,
                                             name="lm_head")
         masked = ltensor.reshape(loss, [-1, 1]) * ltensor.reshape(
@@ -346,7 +351,7 @@ def build(vocab_size=1000, n_layer=4, n_head=8, d_model=256, d_ff=None,
                      d_model=d_model, d_ff=d_ff, max_len=max_len,
                      dropout_rate=dropout_rate, is_test=is_test,
                      dtype=dtype, attn_block_q=attn_block_q,
-                     attn_block_k=attn_block_k)
+                     attn_block_k=attn_block_k, attn_packed=attn_packed)
         flat_logits = ltensor.reshape(logits, [-1, vocab_size])
         flat_labels = ltensor.reshape(safe2d, [-1, 1])
         loss = layers.softmax_with_cross_entropy(flat_logits, flat_labels)

@@ -26,6 +26,7 @@ only softmax state (m/l/lse/p pre-cast) is f32.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -54,8 +55,24 @@ LSE_LANES = 128  # Mosaic min lane tile (in-kernel m/l scratch width);
 # block pair drops from ~block/2 masked columns per row-block (~20% of all
 # flops at t=4096 with 1024 blocks) to the DIAG_W-wide band along the
 # diagonal (~w/t).  256 keeps the sub-dots MXU-shaped ([256, d] x [d, 256])
-# and the unroll at <= 16 regions per straddling cell.
-DIAG_W = 256
+# and the unroll at <= 16 regions per straddling cell.  A process-wide
+# TUNABLE: PADDLE_TPU_DIAG_W pins it (the env knob wins over everything),
+# and the autotune engine (paddle_tpu.tune, docs/autotune.md) sets the
+# module global while measuring a candidate / applying a tuned winner
+# (apply_tuned_diag_w) — the kernels read it at trace time, so fwd and
+# all three bwd kernels always agree within one compile.
+_DIAG_W_ENV = int(os.environ.get("PADDLE_TPU_DIAG_W", "0") or 0)
+DIAG_W = _DIAG_W_ENV or 256
+
+
+def apply_tuned_diag_w(width):
+    """Apply a tuned causal sub-tile width process-wide (the autotune
+    hot path / search loop).  The PADDLE_TPU_DIAG_W env pin always
+    wins; returns the width actually in effect."""
+    global DIAG_W
+    if width and not _DIAG_W_ENV:
+        DIAG_W = int(width)
+    return DIAG_W
 
 
 def _pick_block(t, cap):

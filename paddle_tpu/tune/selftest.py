@@ -1,0 +1,202 @@
+"""``python -m paddle_tpu --tune-selftest`` — the autotune engine's CI
+gate, CPU-only (wired into tools/tier1.sh).
+
+A miniature measured search over a toy transformer proves the whole
+loop off-accelerator:
+
+1. SEARCH: candidates compile through the production AOT path and the
+   HBM preflight REJECTS the over-budget schedules from compiled cost
+   analysis alone (the BENCH_r05 class — policies that save too much
+   activation exceed the planted budget and never execute a step); the
+   measured winner must beat the worst measured candidate.
+2. CACHE: a second invocation is a pure cache hit — zero new compiles
+   (the executor's jit-cache counters pin it) and ``tune.cache_hits``
+   increments.
+3. KILL SWITCH: ``PADDLE_TPU_TUNE=0`` with a POPULATED cache is
+   bit-exact vs the untuned defaults (empty cache), while the tuned
+   path provably applies the winner's geometry to the program.
+4. The t=16k flagship static demonstration rejects the BENCH_r05
+   config (offload at accum=1) and selects a schedule with headroom.
+"""
+
+import json
+import os
+import tempfile
+
+__all__ = ["run_selftest"]
+
+_TOY = dict(seq_len=128, n_layer=3, d_model=64, n_head=2, vocab=61,
+            batch=8, dtype="float32", fused_head=True)
+
+
+def _build_toy(policy="auto"):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    pt.core.unique_name.reset()
+    main_prog, startup = pt.Program(), pt.Program()
+    main_prog.random_seed = 7
+    with pt.program_guard(main_prog, startup):
+        outs = transformer.build(
+            vocab_size=_TOY["vocab"], n_layer=_TOY["n_layer"],
+            n_head=_TOY["n_head"], d_model=_TOY["d_model"],
+            max_len=_TOY["seq_len"], dropout_rate=0.0,
+            dtype=_TOY["dtype"], fused_head=_TOY["fused_head"])
+        if policy:
+            pt.memory_optimize(main_prog, policy=policy)
+    return main_prog, startup, outs
+
+
+def _train_bits(policy="auto", steps=3):
+    """Loss trajectory as float bit patterns (the parity currency)."""
+    import numpy as np
+    import paddle_tpu as pt
+
+    main_prog, startup, outs = _build_toy(policy=policy)
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, _TOY["vocab"],
+                        (_TOY["batch"], _TOY["seq_len"])).astype(np.int64)
+    feed = {"tokens": toks, "labels": np.roll(toks, -1, axis=1)}
+    scope = pt.core.scope.Scope()
+    pt.core.scope._scope_stack.append(scope)
+    try:
+        exe = pt.Executor()
+        exe.run(startup, scope=scope)
+        bits = []
+        for _ in range(steps):
+            loss = exe.run(main_prog, feed=feed,
+                           fetch_list=[outs["avg_cost"]], scope=scope)[0]
+            bits.append(np.asarray(loss, np.float32).tobytes())
+        return bits, exe
+    finally:
+        pt.core.scope._scope_stack.pop()
+
+
+def _flash_attrs(program):
+    """(block_q, block_k) attrs of the program's first flash op."""
+    for op in program.global_block().ops:
+        if op.type in ("flash_attention_packed", "flash_attention"):
+            return (op.attrs.get("block_q"), op.attrs.get("block_k"))
+    return (None, None)
+
+
+def run_selftest():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np  # noqa: F401 — jax initialized before paddle_tpu
+
+    from paddle_tpu import tune
+    from paddle_tpu.observability import get_registry
+
+    failures = []
+
+    def check(cond, what):
+        (failures.append(what) if not cond else None)
+        print(("ok   " if cond else "FAIL ") + what)
+
+    tmp = tempfile.mkdtemp(prefix="pt_tune_")
+    cache_file = os.path.join(tmp, "tuned.json")
+    old_env = {k: os.environ.get(k)
+               for k in ("PADDLE_TPU_TUNE", "PADDLE_TPU_TUNE_CACHE")}
+    os.environ["PADDLE_TPU_TUNE_CACHE"] = cache_file
+    os.environ["PADDLE_TPU_TUNE"] = "search"
+    tune.reset_cache()
+    reg = get_registry()
+    try:
+        # -- 1. measured search with a real HBM preflight ---------------
+        budget = 20 << 20  # between full/compact (~15/18 MB) and
+        # selective/none (~26 MB) compiled high-water on this backend
+        rep = tune.tune_gpt_step(
+            **_TOY, steps=2, warmup=1, repeats=2, budget_bytes=budget,
+            block_caps=(64,), diag_ws=(64,),
+            policies=("none", "selective", "compact", "full"),
+            accums=(1,), max_measure=8)
+        check(rep["source"] == "search" and rep["entry"] is not None,
+              f"search ran and produced a winner ({rep['source']})")
+        rejected = [m for m in rep["measured"]
+                    if m["verdict"] == "preflight_rejected"]
+        measured = [m for m in rep["measured"]
+                    if m["verdict"] == "measured"]
+        check(len(rejected) >= 1 and rep["pruned_preflight"] >= 1,
+              f"HBM preflight rejected {len(rejected)} over-budget "
+              f"schedule(s) from compiled cost analysis alone")
+        check(any(m.get("policy") in ("none", "selective")
+                  for m in rejected),
+              f"the OOM-doomed save-everything schedule is among the "
+              f"rejected ({sorted(m.get('policy') for m in rejected)})")
+        check(all(m.get("hbm_high_water_bytes", 0) <= budget
+                  for m in measured),
+              "every measured candidate fit the budget")
+        win = rep["entry"]["config"]
+        meas = rep["entry"]["measured"]
+        check(len(measured) >= 2
+              and meas["median_s"] < meas["worst_median_s"],
+              f"winner ({win.get('policy')}, {meas['median_s']:.4f}s) "
+              f"beats the worst measured candidate "
+              f"({meas['worst_median_s']:.4f}s)")
+
+        # -- 2. second invocation: pure cache hit, zero recompiles ------
+        os.environ["PADDLE_TPU_TUNE"] = "cached"
+        c0 = reg.value("executor.compile_count")
+        h0 = reg.value("tune.cache_hits")
+        rep2 = tune.tune_gpt_step(**_TOY)
+        check(rep2["source"] == "cache"
+              and rep2["entry"]["config"] == win,
+              "second invocation serves the winner from the cache")
+        check(reg.value("executor.compile_count") == c0,
+              "cache hit compiles NOTHING (jit cache counter flat)")
+        check(reg.value("tune.cache_hits") > h0,
+              "tune.cache_hits incremented")
+
+        # -- 3. tuned config actually reaches the program ---------------
+        main_tuned, _, _ = _build_toy(policy=None)
+        bq, bk = _flash_attrs(main_tuned)
+        check((bq, bk) == (win["block_q"], win["block_k"]),
+              f"hot path applies the tuned geometry (attrs {bq}/{bk} == "
+              f"winner {win['block_q']}/{win['block_k']})")
+
+        # -- 4. kill-switch parity: TUNE=0 bit-exact vs untuned ---------
+        os.environ["PADDLE_TPU_TUNE"] = "0"
+        bits_off, exe_off = _train_bits(policy="auto")
+        os.environ["PADDLE_TPU_TUNE"] = "cached"
+        os.environ["PADDLE_TPU_TUNE_CACHE"] = os.path.join(
+            tmp, "empty", "tuned.json")  # no file: miss -> defaults
+        tune.reset_cache()
+        bits_default, _ = _train_bits(policy="auto")
+        check(bits_off == bits_default,
+              "PADDLE_TPU_TUNE=0 with a populated cache is BIT-EXACT "
+              "vs the untuned defaults (empty cache)")
+        check(exe_off.last_step_cost.get("tune", {}).get("mode") in (
+            None, "off"),
+            "kill-switch run records no tuned lookups")
+        os.environ["PADDLE_TPU_TUNE_CACHE"] = cache_file
+        tune.reset_cache()
+        _, exe_tuned = _train_bits(policy="auto")
+        ts = exe_tuned.last_step_cost.get("tune") or {}
+        check(ts.get("cache_hits", 0) > 0,
+              f"tuned run folds tune stats into last_step_cost ({ts})")
+
+        # -- 5. the t=16k flagship static demonstration -----------------
+        demo = tune.flagship_static_demo()
+        check("rejected" in str(demo.get("gpt_t16k_rejected_r05_config"))
+              or "hbm estimate" in str(
+                  demo.get("gpt_t16k_rejected_r05_config")),
+              f"t16k static prune rejects the BENCH_r05 config "
+              f"({demo.get('gpt_t16k_rejected_r05_config')})")
+        check(demo.get("gpt_t16k_selected_policy") is not None
+              and demo.get("gpt_t16k_selected_accum", 0) >= 1,
+              f"t16k static prune selects a compilable schedule "
+              f"({demo.get('gpt_t16k_selected_policy')} accum="
+              f"{demo.get('gpt_t16k_selected_accum')})")
+        print("tune demo: " + json.dumps(demo))
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        tune.reset_cache()
+
+    print("tune selftest " + ("FAILED" if failures else "PASSED"))
+    return 1 if failures else 0

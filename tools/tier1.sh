@@ -92,6 +92,20 @@ if ! timeout -k 10 900 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_resilience.log >&2
     exit 1
 fi
+# autotune smoke: the measured schedule search on the CPU backend — a
+# toy-transformer search whose HBM preflight rejects over-budget
+# candidates from compiled cost analysis alone and whose winner beats
+# the worst measured candidate, a pure cache hit (zero recompiles) on
+# the second invocation, PADDLE_TPU_TUNE=0 bit-exact vs untuned
+# defaults, and the t=16k static prune rejecting the BENCH_r05 config
+# (docs/autotune.md)
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --tune-selftest \
+        > /tmp/_t1_tune.log 2>&1; then
+    echo "TIER1 REGRESSION: tune selftest failed" >&2
+    cat /tmp/_t1_tune.log >&2
+    exit 1
+fi
 # bench-history gate: every BENCH_*/MULTICHIP_* artifact in the repo
 # must classify (failures acknowledged in tools/bench_known_failures.json
 # with a root cause, never silent) and no tracked metric may regress
