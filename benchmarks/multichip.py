@@ -20,7 +20,12 @@ are deterministic on the virtual CPU mesh:
   boundary reduce set (one cross-chip gradient reduction per OPTIMIZER
   step, not per microbatch), with the executor's accumulation plan in
   ``local`` mode;
-* ``gate_state_bytes``     — optimizer-state bytes/device <= replicated/4.
+* ``gate_state_bytes``     — optimizer-state bytes/device <= replicated/4;
+* ``gate_fsdp_param_sharding`` — on the dp x fsdp=4 mesh the scan-stacked
+  per-layer weights shard at rest (``param_bytes_per_device`` <=
+  replicated / (fsdp_degree/2)), the weight all-gathers sit INSIDE the
+  scan-remat loop, and reduce-class collectives stay out of loop bodies
+  (one gradient reduction per optimizer step, docs/parallel.md "FSDP").
 
 Step times on the virtual CPU mesh share host cores and are indicative
 only; the gates are the contract.
@@ -168,14 +173,20 @@ def _gpt_feed(cfg, batch, seed=0):
     return {"tokens": toks, "labels": lbls}
 
 
-def _train_gpt(cfg, mesh, n_chips, accum, steps, warmup, tp_rules=False):
+def _train_gpt(cfg, mesh, n_chips, accum, steps, warmup, tp_rules=False,
+               fsdp=False):
     """One measured config; returns (step_ms, facts) where facts carries
-    the compiled step's comm/accum/state accounting."""
+    the compiled step's comm/accum/state accounting.  ``fsdp=True``
+    additionally marks remat segments (the scan-remat body is where the
+    in-loop weight gathers live) and tags the per-layer weights for
+    fsdp sharding."""
     import jax
     import paddle_tpu as pt
     from paddle_tpu.parallel import api as papi
 
     main, startup, outs = _build_gpt(cfg, accum)
+    if fsdp:
+        pt.memory_optimize(main, policy="selective")
     if mesh is not None:
         papi.data_parallel(main, "dp", programs=(startup,))
         if tp_rules:
@@ -183,6 +194,8 @@ def _train_gpt(cfg, mesh, n_chips, accum, steps, warmup, tp_rules=False):
 
             for prog in (main, startup):
                 papi.shard_parameters_by_rule(prog, transformer.tp_rules())
+        if fsdp:
+            papi.shard_fsdp(main, programs=(startup,))
     scope = pt.Scope()
     pt.core.scope._scope_stack.append(scope)
     try:
@@ -199,11 +212,20 @@ def _train_gpt(cfg, mesh, n_chips, accum, steps, warmup, tp_rules=False):
             "reduce_ops": sc.get("reduce_ops"),
             "reduce_bytes": sc.get("reduce_bytes"),
             "reduce_ops_in_loop": sc.get("reduce_ops_in_loop"),
+            "collectives_in_loop": sc.get("collectives_in_loop"),
             "accum_plan": sc.get("accum_comm"),
             "compiled_peak_bytes": sc.get("compiled_peak_bytes"),
         }
+        if fsdp:
+            facts["remat_plan"] = list(
+                getattr(exe, "last_remat_plan", []) or [])
         if mesh is not None:
-            rep = papi.optimizer_state_report(main, mesh)
+            srep = papi.sharding_report(main, mesh)
+            facts["param_bytes_replicated"] = (
+                srep["params"]["total_bytes"])
+            facts["param_bytes_per_device"] = (
+                srep["params"]["per_device_bytes"])
+            rep = srep["opt_state"]
             facts["opt_state_bytes_replicated"] = rep["total_bytes"]
             facts["opt_state_bytes_per_device"] = rep["per_device_bytes"]
             facts["opt_state_sharded_vars"] = rep["sharded_vars"]
@@ -301,7 +323,14 @@ def run(row, devices=8, smoke=True, steps=None, warmup=None, accum=4,
         # batch, so perfect scaling keeps the step time flat
         row["scaling_efficiency"] = round(t1 / tn, 3) if tn else None
         row["dp1_cost"] = f1["cost"]
-        row.update({k: v for k, v in fn_.items() if k != "cost"})
+        # param_bytes_* are the FSDP gate's facts: bench_history tracks
+        # param_bytes_per_device as the sharded figure, so the dp-only
+        # run's (fully replicated) values must never ship under the
+        # same metric name
+        row.update({k: v for k, v in fn_.items()
+                    if k not in ("cost", "param_bytes_per_device",
+                                 "param_bytes_replicated",
+                                 "remat_plan")})
         row["dp_cost"] = fn_["cost"]
 
         def _gate_zero():
@@ -324,6 +353,46 @@ def run(row, devices=8, smoke=True, steps=None, warmup=None, accum=4,
         if accum > 1:
             gate("one_reduce_per_step", _gate_one_reduce)
         gate("state_bytes", _gate_bytes)
+
+        if n % 4 == 0:
+            # FSDP / ZeRO-3: dp x fsdp=4 mesh, per-layer weights
+            # sharded at rest, gathered one layer at a time inside the
+            # scan-remat body (docs/parallel.md "FSDP")
+            fsdp_deg = 4
+            log(f"transformer dp={n // fsdp_deg} x fsdp={fsdp_deg} "
+                f"(accum={accum}) ...")
+            mesh_f = make_mesh({"dp": n // fsdp_deg, "fsdp": fsdp_deg},
+                               devices=jax.devices()[:n])
+            tfs, ffs = _train_gpt(cfg, mesh_f, n, accum, steps, warmup,
+                                  fsdp=True)
+            row["dp_fsdp_step_ms"] = round(tfs, 1)
+            row["fsdp_degree"] = fsdp_deg
+            row["param_bytes_per_device"] = ffs.get(
+                "param_bytes_per_device")
+            row["param_bytes_replicated"] = ffs.get(
+                "param_bytes_replicated")
+            row["fsdp_reduce_ops_in_loop"] = ffs.get(
+                "reduce_ops_in_loop")
+            row["fsdp_gathers_in_loop"] = (
+                (ffs.get("collectives_in_loop") or 0)
+                - (ffs.get("reduce_ops_in_loop") or 0))
+            row["fsdp_groups"] = sum(
+                1 for g in ffs.get("remat_plan", ()) if g.get("fsdp"))
+
+            def _gate_fsdp():
+                per = row.get("param_bytes_per_device")
+                total = row.get("param_bytes_replicated")
+                # the acceptance bound: <= replicated / (fsdp_degree/2)
+                assert per and total and per * (fsdp_deg // 2) <= total, (
+                    per, total)
+                assert row["fsdp_groups"] > 0, ffs.get("remat_plan")
+                assert row["fsdp_gathers_in_loop"] > 0, row
+                if accum > 1:
+                    assert row["fsdp_reduce_ops_in_loop"] == 0, row
+                    plan = ffs.get("accum_plan") or {}
+                    assert plan.get("mode") == "local", plan
+
+            gate("fsdp_param_sharding", _gate_fsdp)
 
         if not smoke and n % 2 == 0:
             log(f"transformer dp={n // 2} x tp=2 ...")

@@ -295,7 +295,10 @@ class CheckContext:
         # and the comm checks see an empty module
         jitted = exe._compile(
             self.program, feed_names, fetch_names, state_names)
-        traced = jitted.trace(state, *feed_vals)
+        # fsdp meshes lower with sharding-invariant RNG in production
+        # (Executor._rng_invariant_ctx) — the lint trace must match
+        with exe._rng_invariant_ctx():
+            traced = jitted.trace(state, *feed_vals)
         # the trace populated the executor's remat plan — snapshot it
         # before anything retraces
         self._cache["remat_plan"] = list(
@@ -329,8 +332,11 @@ class CheckContext:
 
     @property
     def compiled(self):
-        return self._get("compiled",
-                         lambda: self.traced.lower().compile())
+        def build():
+            exe = self.prepared[0]
+            with exe._rng_invariant_ctx():
+                return self.traced.lower().compile()
+        return self._get("compiled", build)
 
     @property
     def hlo_text(self):

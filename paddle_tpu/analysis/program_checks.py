@@ -303,3 +303,29 @@ def shape_dtype(ctx):
             "program.shape-dtype", "error", "program",
             _op_loc(block, i, op), msg, hint=hint))
     return findings
+
+
+@register_check("program.shard-fallback", level="program")
+def shard_fallback(ctx):
+    """Sharding fallbacks recorded at spec-resolution time
+    (``parallel.api._record_shard_fallback``): a var that COULD have
+    sharded over dp (ZeRO-1 accumulators) or fsdp (per-layer weights)
+    but replicated instead — indivisible leading dims, rank mismatches.
+    Info-level: replication is always correct, but at a capacity config
+    it silently forfeits the bytes/device the shard exists to save, so
+    each fallback is named here (and counted in
+    ``parallel.shard_fallbacks``) instead of vanishing."""
+    recs = getattr(ctx.program.global_block(), "_shard_fallbacks",
+                   None) or {}
+    findings = []
+    for (name, axis), reason in sorted(recs.items()):
+        if len(findings) >= MAX_FINDINGS:
+            break
+        findings.append(ctx.finding(
+            "program.shard-fallback", "info", "program", f"var {name}",
+            f"{axis} shard fell back to replication: {reason}",
+            hint="pad/resize the dim to divide the mesh axis (or accept "
+                 "the replicated bytes); sharding_report shows the "
+                 "per-device cost",
+            data={"var": name, "axis": axis, "reason": reason}))
+    return findings

@@ -112,6 +112,66 @@ def _tag_named(v, tag):
     return v
 
 
+def _fsdp_fwd_pin(sharding):
+    """Forward-only sharding constraint: the primal is pinned to
+    ``sharding``, the cotangent passes through UNPINNED.  Both FSDP
+    pins use it — the at-rest stack pin (``P(None, *spec)``: at-rest
+    bytes divide by the fsdp degree) and the in-body per-layer gather
+    (the fsdp-free spec: GSPMD emits the all-gather inside the loop
+    body and XLA frees the gathered copy when the iteration's uses
+    finish).
+
+    Why not a plain ``with_sharding_constraint``?  It transposes to
+    itself, constraining the BACKWARD too — the gather's transpose
+    forces every per-layer dW to full replication inside the backward
+    scan, and the stack pin's transpose (or any sharded dW constraint)
+    makes GSPMD feature-shard the saved residuals, turning the in-body
+    LN/softmax reductions into partial sums plus in-loop all-reduces
+    (measured: 19-49 in-loop reduce ops on the dp2 x fsdp4 mesh,
+    depending on spelling).  Left free, the dW values stay replicated
+    over fsdp all the way to the optimizer boundary, where the
+    elementwise update against the fsdp-sharded moments reads them
+    shard-locally (a free slice, outside every loop)."""
+
+    @jax.custom_vjp
+    def pin(x):
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    def pin_fwd(x):
+        return jax.lax.with_sharding_constraint(x, sharding), None
+
+    def pin_bwd(_, ct):
+        return (ct,)
+
+    pin.defvjp(pin_fwd, pin_bwd)
+    return pin
+
+
+def _ensure_barrier_batch_rule():
+    """``jax.lax.optimization_barrier`` has no batching rule in this jax
+    (0.4.x) — vmapping a barrier-remat segment (the comm-aware
+    accumulation loop vmaps the microbatch forward+backward over device
+    groups) dies with NotImplementedError and silently forfeits local
+    accumulation.  The barrier is identity per operand, so the rule is
+    the trivial pass-through; upstream jax added exactly this later.
+    Registered once, only if absent."""
+    try:
+        from jax._src.lax import lax as _llax
+        from jax.interpreters import batching
+
+        prim = getattr(_llax, "optimization_barrier_p", None)
+        if prim is not None and prim not in batching.primitive_batchers:
+            def _rule(args, dims, **params):
+                return prim.bind(*args, **params), dims
+
+            batching.primitive_batchers[prim] = _rule
+    except Exception:  # noqa: BLE001 — newer jax ships its own rule
+        pass
+
+
+_ensure_barrier_batch_rule()
+
+
 def _remat_segment(seg_fn, env, param_names=()):
     """``jax.checkpoint``-equivalent for one forward segment whose backward
     recompute is made DATA-DEPENDENT on the incoming cotangents via
@@ -342,6 +402,50 @@ class Executor:
         # analogue of last_remat_plan.  None when the step has no accum.
         self.last_accum_plan = None
 
+    def _fsdp_active(self, program):
+        """True when the scan-remat body should gather FSDP-sharded
+        per-layer weights in-loop: an ``fsdp`` mesh axis of size > 1,
+        the ``PADDLE_TPU_FSDP`` kill switch on, and the program not
+        opted out (``program._fsdp = False`` — the autotuner's
+        gather-vs-replicate schedule dimension,
+        ``memory_optimize(policy="auto")``)."""
+        from ..parallel.api import _fsdp_enabled
+        from ..parallel.mesh import axis_size
+
+        if self.mesh is None or not _fsdp_enabled():
+            return False
+        if getattr(program, "_fsdp", True) is False:
+            return False
+        return axis_size(self.mesh, "fsdp") > 1
+
+    def _rng_invariant_ctx(self):
+        """Sharding-invariant RNG for compiles on an ``fsdp`` mesh.
+
+        The legacy (non-partitionable) threefry lowering produces
+        DIFFERENT values when a random op's output is sharded — an
+        FSDP-sharded weight would be *initialized differently* than its
+        replicated spelling, breaking the bit-exactness contract the
+        kill switches are gated on.  The partitionable lowering derives
+        each element from its global counter regardless of
+        partitioning, so values never depend on the layout.  Scoped to
+        meshes WITH an fsdp axis (the only place random outputs shard)
+        and deliberately independent of ``PADDLE_TPU_FSDP`` — both
+        spellings of the bit-exactness comparison must lower the same
+        way; everything off the fsdp mesh keeps the legacy stream
+        (tests pin scan-vs-unrolled dropout bit-exactness on it)."""
+        import contextlib
+
+        from ..parallel.mesh import axis_size
+
+        if axis_size(self.mesh, "fsdp") > 1:
+            try:
+                from jax._src.config import threefry_partitionable
+
+                return threefry_partitionable(True)
+            except Exception:  # noqa: BLE001 — newer jax: already on
+                pass
+        return contextlib.nullcontext()
+
     def _aot_compile(self, jitted, args, label, program=None,
                      fetch_names=()):
         """Explicit ``lower().compile()`` instead of first-call jit, so
@@ -358,7 +462,8 @@ class Executor:
         Returns ``(fn, cost)``."""
         reg = _obs.get_registry()
         t0 = time.perf_counter()
-        compiled = jitted.lower(*args).compile()
+        with self._rng_invariant_ctx():
+            compiled = jitted.lower(*args).compile()
         dt = time.perf_counter() - t0
         reg.counter(
             "executor.compile_count",
@@ -875,7 +980,7 @@ class Executor:
                         needed_after.reverse()  # needed_after[i] = used
                         # by ops[i:] (+loss/aux); index bw == just aux
 
-                        def _try_scan_group(group):
+                        def _try_scan_group(group, use_fsdp=True):
                             """Run ``segments[i0 : i0 + P*G]`` — G
                             structurally identical periods of P segments
                             (one transformer layer each) — as ONE
@@ -888,16 +993,32 @@ class Executor:
                             its iteration's cotangent arrives), so remat
                             temps are O(1) per layer — the compilable HLO
                             the barrier spelling could not guarantee at
-                            t=16k.  Returns False (caller falls back to the
-                            per-segment barrier path) when the group cannot
-                            be classified into carry/xs/shared inputs or
-                            the scan fails to trace."""
+                            t=16k.
+
+                            FSDP rides the same structure: xs entries
+                            whose parameter resolves an ``fsdp`` spec
+                            stay SHARDED in the stacked at-rest form
+                            (``P(None, *spec)`` — at-rest bytes divide
+                            by the fsdp degree) and each layer's slice
+                            is constrained to the fsdp-free spec INSIDE
+                            the body, so GSPMD emits the all-gather in
+                            the loop and frees the gathered slice after
+                            its layer — live parameter bytes are O(one
+                            layer), the PR-3 remat trick applied to
+                            weights.  Returns False (caller falls back
+                            to the per-segment barrier path) when the
+                            group cannot be classified into
+                            carry/xs/shared inputs or the scan fails to
+                            trace; an fsdp-constrained trace failure
+                            first retries WITHOUT the constraints
+                            (``executor.fsdp_fallbacks``)."""
                             i0, P, G = (group["start"], group["period"],
                                         group["count"])
                             ext_maps = group["ext_maps"]
                             out_maps = group["out_maps"]
                             c0 = fctx._op_counter
                             reg = _obs.get_registry()
+                            fsdp_gather = {}
                             try:
                                 out0 = list(out_maps[0].keys())
                                 out_sets = [set(m.values()) for m in out_maps]
@@ -993,6 +1114,42 @@ class Executor:
                                          for k in range(G)])
                                     for n in xs_names
                                 }
+                                if use_fsdp and self._fsdp_active(
+                                        program):
+                                    from jax.sharding import (
+                                        NamedSharding as _NS,
+                                        PartitionSpec as _PS)
+
+                                    from ..parallel.api import \
+                                        fsdp_spec_for
+
+                                    for n in xs_names:
+                                        v_ = block._find_var(n)
+                                        spec = fsdp_spec_for(
+                                            v_, self.mesh, block
+                                        ) if v_ is not None else None
+                                        if spec is None:
+                                            continue
+                                        gathered = _PS(*(
+                                            (tuple(a for a in ent
+                                                   if a != "fsdp")
+                                             or None)
+                                            if isinstance(ent, tuple)
+                                            else (None if ent == "fsdp"
+                                                  else ent)
+                                            for ent in spec))
+                                        # at rest: the stack stays
+                                        # fsdp-sharded on the weight's
+                                        # leading (non-scan) axis
+                                        xs_stacked[n] = \
+                                            _fsdp_fwd_pin(
+                                                _NS(self.mesh,
+                                                    _PS(None, *spec)))(
+                                                xs_stacked[n])
+                                        fsdp_gather[n] = \
+                                            _fsdp_fwd_pin(
+                                                _NS(self.mesh,
+                                                    gathered))
                                 carry0 = {n: e[n] for n in carry_map}
                                 # offload ("host"/"save"): the ONE change
                                 # vs plain selective execution is that
@@ -1017,6 +1174,18 @@ class Executor:
 
                                 def body(carry, xs):
                                     k_idx, xvals = xs
+                                    if fsdp_gather:
+                                        # gather THIS layer's weight
+                                        # slices to their fsdp-free
+                                        # spec inside the loop body:
+                                        # XLA frees them when the
+                                        # iteration's uses finish, so
+                                        # only one layer is ever live
+                                        # gathered
+                                        xvals = dict(xvals)
+                                        for n_, g_ in \
+                                                fsdp_gather.items():
+                                            xvals[n_] = g_(xvals[n_])
                                     e2 = dict(shared_env)
                                     e2.update(carry)
                                     e2.update(xvals)
@@ -1087,11 +1256,18 @@ class Executor:
                                     "executor.scan_remat_groups",
                                     help="remat segment groups executed as "
                                          "lax.scan over layers").inc()
+                                if fsdp_gather:
+                                    reg.counter(
+                                        "executor.fsdp_groups",
+                                        help="scan groups whose stacked "
+                                             "weights are fsdp-sharded "
+                                             "with in-loop gathers").inc()
                                 plan_log.append(
                                     {"start": i0, "period": P, "count": G,
                                      "carry": sorted(carry_map),
                                      "xs": len(xs_names),
                                      "shared": len(shared_names),
+                                     "fsdp": len(fsdp_gather),
                                      "offload": off_mode})
                                 return True
                             except Exception as exc:
@@ -1102,13 +1278,29 @@ class Executor:
                                 # fallback at a capacity config is a
                                 # runtime OOM waiting to happen: BENCH_r05)
                                 fctx._op_counter = c0
+                                reason = " ".join(
+                                    f"{type(exc).__name__}: {exc}"
+                                    .split())[:200]
+                                if fsdp_gather:
+                                    # the fsdp constraints are the only
+                                    # delta vs the proven scan spelling:
+                                    # drop them and keep the scan before
+                                    # surrendering to the barrier path
+                                    reg.counter(
+                                        "executor.fsdp_fallbacks",
+                                        help="scan groups whose fsdp "
+                                             "constraints failed to trace "
+                                             "(retried replicated)").inc()
+                                    plan_log.append(
+                                        {"start": i0, "period": P,
+                                         "count": G,
+                                         "fsdp_fallback": reason})
+                                    return _try_scan_group(
+                                        group, use_fsdp=False)
                                 reg.counter(
                                     "executor.scan_remat_fallbacks",
                                     help="segment groups that fell back to "
                                          "the barrier spelling").inc()
-                                reason = " ".join(
-                                    f"{type(exc).__name__}: {exc}"
-                                    .split())[:200]
                                 plan_log.append(
                                     {"start": i0, "period": P, "count": G,
                                      "fallback": reason})
@@ -1204,6 +1396,18 @@ class Executor:
 
                     for n, g in grads.items():
                         var = block._find_var(n)
+                        # deliberately the EXPLICIT spec, not the
+                        # fsdp-composed resolution: gradients stay
+                        # replicated over fsdp at the boundary.
+                        # Pinning them fsdp-sharded here lets GSPMD
+                        # reshard shared forward/backward
+                        # subcomputations to suit the sharded
+                        # consumer, which breaks the bit-exactness
+                        # contract at the ulp level (measured on the
+                        # fsdp-only and tp-composed meshes); the
+                        # sharded-gradient (reduce-scatter) spelling
+                        # is the ROADMAP item-2 remainder, and
+                        # sharding_report accounts grads at this spec
                         spec = (getattr(var, "partition_spec", None)
                                 if var is not None else None) or _P()
                         env[n + GRAD_SUFFIX] = (
@@ -1433,6 +1637,13 @@ class Executor:
                 return jax.grad(fwd, has_aux=True)(tparams, e0)
 
             g, aux = jax.vmap(lane)(feeds_k)
+            # the [ndp, ...] f32 carry shards ONLY its group axis over
+            # dp; an FSDP weight's dW deliberately stays replicated
+            # over fsdp through the loops (an fsdp-sharded constraint
+            # here makes GSPMD feature-shard the saved residuals,
+            # turning in-body LN/softmax reductions into in-loop
+            # all-reduces) — the optimizer-boundary pin reshards it
+            # once, outside every loop
             gacc = jax.tree_util.tree_map(
                 lambda a, gi: dp_sharded(a + gi.astype(jnp.float32)),
                 gacc, g)

@@ -198,6 +198,11 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
 
         cfg = program_schedule_config(program) or {}
         policy = cfg.get("policy") or "selective"
+        if "fsdp" in cfg:
+            # the tuned gather-vs-replicate decision (schedule_candidates'
+            # fsdp dimension): False opts the Executor's scan body out of
+            # the in-loop FSDP weight gathers for this program
+            program._fsdp = bool(cfg["fsdp"])
         if policy == "none":
             program._offload = False
             program._remat_segments = []

@@ -71,7 +71,7 @@ _EXTRA_METRICS = (
     "gpt_tokens_per_sec_per_chip", "gpt_mfu", "gate_flagship_gpt_seq",
     "gpt_t16k_tune_tok_s",
 )
-_MULTICHIP_METRICS = ("scaling_efficiency",)
+_MULTICHIP_METRICS = ("scaling_efficiency", "param_bytes_per_device")
 _SERVING_METRICS = ("tok_s", "speedup")
 # surfaced in the trajectory table but EXEMPT from regression flagging,
 # each with its root-caused reason (ROADMAP known-regression triage):
@@ -92,6 +92,14 @@ _REGRESSION_EXEMPT = {
     "resnet50_train_images_per_sec_per_chip":
         "r04 dip root-caused as shared-runner noise; bench medians "
         "BENCH_REPEATS regions since (bench.py timed_steps)",
+    # FSDP capacity figure from the tiny virtual-CPU-mesh smoke model:
+    # LOWER is better (the flagger assumes higher-is-better) and the
+    # absolute value tracks the toy model's size, not the engine —
+    # gate_fsdp_param_sharding's <= replicated/(fsdp_degree/2) bound is
+    # the contract (benchmarks/multichip.py)
+    "param_bytes_per_device":
+        "lower-is-better bytes figure on the virtual CPU mesh; the "
+        "multichip gate_fsdp_param_sharding bound is the contract",
 }
 
 # the t=16k rot class and its resolution evidence: a FAILED artifact

@@ -27,7 +27,10 @@ from .api import (
     replicate,
     P,
     zero_spec_for,
+    fsdp_spec_for,
+    shard_fsdp,
     optimizer_state_report,
+    sharding_report,
     comm_overlap_flags,
     enable_comm_overlap,
 )
@@ -39,7 +42,8 @@ from . import sparse
 __all__ = [
     "make_mesh", "single_host_mesh", "axis_size", "compile_shardings",
     "data_parallel", "shard_parameter", "replicate", "P", "zero_spec_for",
-    "optimizer_state_report", "comm_overlap_flags", "enable_comm_overlap",
+    "fsdp_spec_for", "shard_fsdp", "optimizer_state_report",
+    "sharding_report", "comm_overlap_flags", "enable_comm_overlap",
     "ring_attention", "blockwise_attention", "pipeline",
     "stack_stage_params", "init_moe_params", "moe_ffn", "sparse",
 ]

@@ -14,6 +14,18 @@ leading axis over the ``dp`` mesh axis, so XLA stores each chip's shard
 of the moments, updates it against that shard of the gradient, and
 all-gathers only the updated parameters.  Contract and fallback rules in
 ``zero_spec_for`` (docs/parallel.md).
+
+FSDP / ZeRO-3 parameter sharding extends it to the parameters
+themselves: ``shard_fsdp`` tags each scan-group's per-layer (stacked)
+weights, ``fsdp_spec_for`` composes an ``fsdp`` shard onto their leading
+non-scan axis (on top of any tensor-parallel spec), and the Executor's
+scan-remat body all-gathers each layer's slice INSIDE the scan step so
+live parameter bytes are O(one layer) while at-rest bytes divide by the
+fsdp degree.  Accumulators inherit the composed spec through
+``zero_spec_for``, so optimizer state shards along with its parameter.
+Every replication fallback (indivisible shapes) is recorded on the
+block and surfaced by the ``program.shard-fallback`` analysis check and
+the ``parallel.shard_fallbacks`` counter — never silently.
 """
 
 import os
@@ -28,7 +40,8 @@ from ..core.scope import RNG_VAR
 from .mesh import axis_size
 
 __all__ = ["compile_shardings", "data_parallel", "shard_parameter",
-           "replicate", "P", "zero_spec_for", "optimizer_state_report",
+           "replicate", "P", "zero_spec_for", "fsdp_spec_for",
+           "shard_fsdp", "optimizer_state_report", "sharding_report",
            "comm_overlap_flags", "enable_comm_overlap"]
 
 
@@ -40,6 +53,111 @@ def _zero_enabled():
         "0", "", "false")
 
 
+def _fsdp_enabled():
+    """FSDP parameter-sharding kill switch (``PADDLE_TPU_FSDP=0``): off
+    means every parameter keeps its explicit (tp) spec or replicates —
+    the bit-exactness reference spelling, exactly like PADDLE_TPU_ZERO."""
+    return os.environ.get("PADDLE_TPU_FSDP", "1").lower() not in (
+        "0", "", "false")
+
+
+def _spec_axes(spec):
+    """Every mesh axis a PartitionSpec entry list mentions."""
+    return {a for e in spec if e
+            for a in (e if isinstance(e, tuple) else (e,))}
+
+
+def _record_shard_fallback(block, var, axis, reason):
+    """A var that COULD have sharded over ``axis`` but fell back to its
+    inherited spec / replication: recorded once per (var, axis) on the
+    block (the ``program.shard-fallback`` analysis check reads it) and
+    counted in ``parallel.shard_fallbacks`` — a silent fallback at a
+    capacity config is an OOM waiting to happen (the scan-remat
+    fallback discipline)."""
+    if block is None:
+        return
+    rec = getattr(block, "_shard_fallbacks", None)
+    if rec is None:
+        rec = block._shard_fallbacks = {}
+    key = (var if isinstance(var, str) else var.name, axis)
+    if key in rec:
+        return
+    rec[key] = reason
+    from ..observability import metrics as _obs
+
+    _obs.get_registry().counter(
+        "parallel.shard_fallbacks",
+        help="vars whose dp/fsdp shard fell back to replication "
+             "(indivisible shapes; program.shard-fallback names them)",
+    ).inc()
+
+
+def fsdp_spec_for(var, mesh, block=None):
+    """The FSDP/ZeRO-3 PartitionSpec for one tagged parameter, or None.
+
+    Rules (docs/parallel.md):
+    * only vars ``shard_fsdp`` tagged (``fsdp_param`` — a scan-group's
+      per-layer stacked weights) are candidates, and only on a mesh
+      with an ``fsdp`` axis of size > 1;
+    * the parameter keeps its existing (tensor-parallel) spec and the
+      LEADING non-scan axis additionally shards over ``fsdp`` —
+      composing into a tuple entry when tp already shards that axis —
+      iff the dim divides the product of all axes sharding it;
+    * indivisible shapes fall back to the inherited spec (None here —
+      callers then use ``partition_spec`` as before) with the reason
+      recorded via ``_record_shard_fallback``;
+    * kill switches: ``PADDLE_TPU_FSDP=0`` and the program-level
+      ``program._fsdp = False`` (the autotuner's replicate schedule,
+      ``memory_optimize(policy="auto")``) both resolve every candidate
+      to None — the replicated reference spelling, checked bit-exact.
+      The program opt-out rides the BLOCK's program so the Executor's
+      scan-body gathers and compile_shardings flip together: a
+      replicate winner must measure the true replicated schedule, not
+      a sharded-at-rest hybrid with no pin discipline.
+    """
+    if not _fsdp_enabled():
+        return None
+    if block is not None and getattr(
+            getattr(block, "program", None), "_fsdp", True) is False:
+        return None
+    nf = axis_size(mesh, "fsdp")
+    if nf <= 1 or not getattr(var, "fsdp_param", False):
+        return None
+    shape = tuple(var.shape or ())
+    if not shape:
+        _record_shard_fallback(block, var, "fsdp", "scalar shape")
+        return None
+    base = list(getattr(var, "partition_spec", None) or ())
+    if len(base) > len(shape):
+        _record_shard_fallback(
+            block, var, "fsdp",
+            f"spec rank {len(base)} exceeds shape rank {len(shape)}")
+        return None
+    base += [None] * (len(shape) - len(base))
+    if "fsdp" in _spec_axes(base):
+        return P(*base)  # already explicitly fsdp-sharded
+    entry = base[0]
+    cur = (entry if isinstance(entry, tuple) else (entry,)) if entry \
+        else ()
+    if "dp" in cur:
+        _record_shard_fallback(
+            block, var, "fsdp", "leading axis already sharded over dp")
+        return None
+    mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    denom = nf
+    for a in cur:
+        denom *= mesh_sizes.get(a, 1)
+    dim = abs(int(shape[0])) if shape[0] else 0
+    if not dim or dim % denom:
+        _record_shard_fallback(
+            block, var, "fsdp",
+            f"leading dim {shape[0]} not divisible by "
+            f"{'x'.join([*cur, 'fsdp'])}={denom}")
+        return None
+    base[0] = (*cur, "fsdp") if cur else "fsdp"
+    return P(*base)
+
+
 def zero_spec_for(var, mesh, block=None):
     """The ZeRO-1 PartitionSpec for one optimizer accumulator, or None.
 
@@ -47,19 +165,27 @@ def zero_spec_for(var, mesh, block=None):
     * only vars tagged ``zero_param`` (per-parameter accumulators) are
       candidates — beta-pow/learning-rate scalars never shard;
     * an explicit ``partition_spec`` always wins (callers check first);
-    * the accumulator inherits its parameter's PartitionSpec (so a
-      tensor-parallel ``[d, 4d]`` FFN weight's moments stay tp-sharded
-      next to it), then its LEADING axis is sharded over ``dp`` iff that
-      axis is free, the dim divides the dp size, and no other axis
-      already uses ``dp``;
+    * the accumulator inherits its parameter's RESOLVED PartitionSpec —
+      the fsdp-composed spec when the parameter is FSDP-sharded, else
+      its explicit (tp) spec — so a tensor-parallel ``[d, 4d]`` FFN
+      weight's moments stay tp-sharded next to it and an FSDP weight's
+      moments shard along with it (the ZeRO-3 state discipline); then
+      its LEADING axis is sharded over ``dp`` iff that axis is free,
+      the dim divides the dp size, and no other axis already uses
+      ``dp``;
     * uneven/small shapes (leading dim not divisible — scalars, odd
-      embeddings) fall back to the inherited spec, or full replication.
+      embeddings) fall back to the inherited spec, or full replication,
+      with the skipped dp shard recorded via ``_record_shard_fallback``
+      (the ``program.shard-fallback`` check surfaces it).
     """
     if not _zero_enabled():
         return None
+    if mesh is None:
+        return None
     ndp = axis_size(mesh, "dp")
+    nf = axis_size(mesh, "fsdp")
     pname = getattr(var, "zero_param", None)
-    if ndp <= 1 or pname is None:
+    if pname is None or (ndp <= 1 and nf <= 1):
         return None
     shape = tuple(var.shape or ())
     if not shape:
@@ -67,22 +193,38 @@ def zero_spec_for(var, mesh, block=None):
     base = [None] * len(shape)
     if block is not None:
         pvar = block._find_var(pname)
-        pspec = getattr(pvar, "partition_spec", None) if pvar else None
+        pspec = None
+        if pvar is not None:
+            pspec = fsdp_spec_for(pvar, mesh, block)
+            if pspec is None:
+                pspec = getattr(pvar, "partition_spec", None)
         if pspec is not None:
             if len(pspec) > len(shape):
+                _record_shard_fallback(
+                    block, var, "dp",
+                    f"parameter spec rank {len(pspec)} exceeds "
+                    f"accumulator rank {len(shape)}")
                 return None  # shape mismatch: stay replicated
             base[:len(pspec)] = list(pspec)
-    used = {a for e in base if e for a in
-            (e if isinstance(e, tuple) else (e,))}
-    if (base[0] is None and "dp" not in used and shape[0]
-            and int(shape[0]) % ndp == 0):
-        base[0] = "dp"
+    used = _spec_axes(base)
+    if ndp > 1 and base[0] is None and "dp" not in used and shape[0]:
+        if int(shape[0]) % ndp == 0:
+            base[0] = "dp"
+        else:
+            _record_shard_fallback(
+                block, var, "dp",
+                f"leading dim {shape[0]} not divisible by dp={ndp}")
     if all(e is None for e in base):
         return None
     return P(*base)
 
 
 def _spec_for(var, mesh, block=None):
+    # the fsdp composition subsumes (extends) an explicit tp spec, so it
+    # resolves first; a fallback (None) restores the explicit-spec path
+    spec = fsdp_spec_for(var, mesh, block)
+    if spec is not None:
+        return spec
     spec = getattr(var, "partition_spec", None)
     if spec is not None:
         return spec
@@ -158,8 +300,99 @@ def shard_parameters_by_rule(program, rules):
     return program
 
 
+def shard_fsdp(program, programs=()):
+    """Tag each scan-group's per-layer (scan-stacked) parameters for
+    FSDP sharding (``var.fsdp_param = True``; ``fsdp_spec_for`` resolves
+    the tags at compile time, so ``PADDLE_TPU_FSDP=0`` still restores
+    the replicated spelling afterwards).
+
+    The tagged set is exactly what the Executor's scan-remat engine
+    stacks along the scan axis: when ``memory_optimize`` has marked
+    ``program._remat_segments`` (call it FIRST), the groups come from
+    the SAME ``core/executor._scan_groups_for`` the executor runs —
+    including its wrapped-segment filter and the
+    ``PADDLE_TPU_SCAN_REMAT=0`` kill switch, so a group that will not
+    scan is never tagged.  Without marked segments the structural
+    matcher falls back to a ``detect_repeated_run`` tiling of the
+    forward prefix — there is no scan body then, so this is pure
+    at-rest sharding (GSPMD places the gathers in the unrolled code).
+    In either case every external input that maps to a DIFFERENT
+    Parameter per period is a per-layer weight.  Shared inputs
+    (constants used identically every layer), carried activations and
+    non-repeated parameters (embeddings, the LM head) are left
+    untouched: they are consumed outside the scan body, and sharding
+    them would move their gathers outside the loop.
+
+    ``programs`` (e.g. the startup program) receive the same tags by
+    variable name so their out-shardings create the parameters
+    pre-sharded.  Returns the sorted tagged names; an EMPTY return
+    (no repeated structure / scan engine off) records a
+    program-level ``_record_shard_fallback`` so the no-op is
+    observable, never silent."""
+    from ..core.ir import detect_repeated_run, find_uniform_groups
+    from ..core.program import Parameter
+
+    block = program.global_block()
+
+    def _fallback_empty(reason):
+        _record_shard_fallback(block, "<program>", "fsdp", reason)
+        return []
+
+    segments = list(getattr(program, "_remat_segments", None) or ())
+    if segments:
+        from ..core.executor import _scan_groups_for
+
+        groups = _scan_groups_for(program, segments)
+        if not groups:
+            return _fallback_empty(
+                "no scan-able uniform segment group (or "
+                "PADDLE_TPU_SCAN_REMAT=0) — parameters stay replicated")
+    else:
+        bw = block.backward_index
+        n_fwd = bw if bw is not None else len(block.ops)
+        hit = detect_repeated_run(program, 0, n_fwd)
+        if hit is None:
+            return _fallback_empty(
+                "no repeated layer structure found — parameters stay "
+                "replicated")
+        s0, p, cnt = hit
+        segs = [(s0 + k * p, s0 + (k + 1) * p, True)
+                for k in range(cnt)]
+        groups = find_uniform_groups(program, segs)
+    names = set()
+    for g in groups:
+        ext_maps, count = g["ext_maps"], g["count"]
+        for n in ext_maps[0]:
+            vals = [ext_maps[k][n] for k in range(count)]
+            if len(set(vals)) <= 1:
+                continue  # shared input (or single period)
+            vars_ = [block._find_var(v) for v in vals]
+            if all(v is not None and isinstance(v, Parameter)
+                   for v in vars_):
+                names.update(vals)
+    if not names:
+        return _fallback_empty(
+            "repeated structure has no per-layer Parameters — "
+            "parameters stay replicated")
+    for prog in (program, *programs):
+        blk = prog.global_block()
+        for n in names:
+            v = blk._find_var(n)
+            if v is not None:
+                v.fsdp_param = True
+        # the gather-vs-replicate schedule decision
+        # (memory_optimize(policy="auto") -> program._fsdp) must
+        # resolve identically for every program touching these vars —
+        # a startup that creates them sharded while the opted-out main
+        # expects them replicated is a compile-time sharding mismatch
+        if hasattr(program, "_fsdp"):
+            prog._fsdp = program._fsdp
+    return sorted(names)
+
+
 def replicate(var):
     var.partition_spec = P()
+    var.fsdp_param = False  # opt this var out of shard_fsdp tags too
     return var
 
 
@@ -177,36 +410,104 @@ def optimizer_state_report(program, mesh):
 
     Pure metadata — no arrays are touched, so it also works pre-startup
     and is what ``benchmarks/multichip.py`` and the multichip selftest
-    gate (``per_device_bytes <= replicated/4`` on the dp=8 mesh)."""
+    gate (``per_device_bytes <= replicated/4`` on the dp=8 mesh).
+    ``sharding_report`` is the generalization covering parameter and
+    gradient bytes too."""
+    return sharding_report(program, mesh)["opt_state"]
+
+
+def _var_shard_bytes(var, mesh, mesh_sizes, block, spec=None):
+    """(full_bytes, per_device_bytes, spec) for one var under its
+    resolved PartitionSpec (or an explicit ``spec`` override) — the
+    shared accounting of ``sharding_report`` /
+    ``optimizer_state_report``."""
+    shape = tuple(abs(int(s)) for s in (var.shape or ()))
+    numel = int(np.prod(shape)) if shape else 1
+    try:
+        itemsize = np.dtype(
+            var.dtype.name if hasattr(var.dtype, "name")
+            else var.dtype).itemsize
+    except TypeError:
+        itemsize = 4
+    nbytes = numel * itemsize
+    if spec is None:
+        spec = _spec_for(var, mesh, block)
+    frac = 1
+    for entry in spec:
+        for ax in (entry if isinstance(entry, tuple)
+                   else (entry,) if entry else ()):
+            frac *= mesh_sizes.get(ax, 1)
+    return nbytes, nbytes // max(frac, 1), spec
+
+
+def sharding_report(program, mesh):
+    """Static bytes/device accounting under the resolved shardings for
+    the THREE per-parameter state classes the memory ceiling is made of:
+
+    * ``params``    — the model weights (FSDP is what shrinks these);
+    * ``opt_state`` — optimizer-owned persistables (``optimizer_state``
+      tag: accumulators, beta-pows, lr — ZeRO-1/3 territory);
+    * ``grads``     — one transient gradient per parameter, accounted at
+      the parameter's EXPLICIT spec — the spec the Executor actually
+      pins each gradient to at the backward/optimizer boundary.  This
+      is deliberately NOT the fsdp-composed resolution: gradients stay
+      replicated over ``fsdp`` (pinning them sharded lets GSPMD reshard
+      shared forward subcomputations and breaks bit-exactness at the
+      ulp level); the sharded-gradient reduce-scatter spelling is the
+      ROADMAP item-2 remainder.
+
+    Each section carries ``total_bytes`` (the logical, fully-replicated
+    figure), ``per_device_bytes`` under the resolved specs,
+    ``replicated_per_device_bytes`` (== total: the kill-switch figure),
+    ``sharded_vars`` / ``replicated_vars`` counts and a per-var
+    ``vars`` dict.  Pure metadata — works pre-startup; gated by the
+    multichip selftest (param bytes/device <= replicated/2 on the
+    fsdp=4 mesh) and ``benchmarks/multichip.py``."""
+    from ..core.program import Parameter
+
     block = program.global_block()
     mesh_sizes = (dict(zip(mesh.axis_names, mesh.devices.shape))
                   if mesh is not None else {})
-    out = {"total_bytes": 0, "per_device_bytes": 0,
-           "sharded_vars": 0, "replicated_vars": 0, "vars": {}}
+
+    def section():
+        return {"total_bytes": 0, "per_device_bytes": 0,
+                "sharded_vars": 0, "replicated_vars": 0, "vars": {}}
+
+    out = {"params": section(), "opt_state": section(),
+           "grads": section()}
     for var in block.vars.values():
-        if not getattr(var, "optimizer_state", False):
+        sections = []
+        if isinstance(var, Parameter):
+            sections += ["params", "grads"]
+        if getattr(var, "optimizer_state", False):
+            sections.append("opt_state")
+        if not sections:
             continue
-        shape = tuple(abs(int(s)) for s in (var.shape or ()))
-        numel = int(np.prod(shape)) if shape else 1
-        try:
-            itemsize = np.dtype(
-                var.dtype.name if hasattr(var.dtype, "name")
-                else var.dtype).itemsize
-        except TypeError:
-            itemsize = 4
-        nbytes = numel * itemsize
-        spec = _spec_for(var, mesh, block)
-        frac = 1
-        for entry in spec:
-            for ax in (entry if isinstance(entry, tuple)
-                       else (entry,) if entry else ()):
-                frac *= mesh_sizes.get(ax, 1)
-        out["total_bytes"] += nbytes
-        out["per_device_bytes"] += nbytes // max(frac, 1)
-        out["sharded_vars" if frac > 1 else "replicated_vars"] += 1
-        out["vars"][var.name] = {
-            "bytes": nbytes, "per_device_bytes": nbytes // max(frac, 1),
-            "spec": str(spec)}
+        resolved = _var_shard_bytes(var, mesh, mesh_sizes, block)
+        for s in sections:
+            if s == "grads":
+                # the boundary pin's spec: explicit (tp) only, never
+                # fsdp-composed — see the docstring
+                nbytes, per_dev, spec = _var_shard_bytes(
+                    var, mesh, mesh_sizes, block,
+                    spec=getattr(var, "partition_spec", None) or P())
+            else:
+                nbytes, per_dev, spec = resolved
+            sec = out[s]
+            sec["total_bytes"] += nbytes
+            sec["per_device_bytes"] += per_dev
+            sec["sharded_vars" if per_dev < nbytes
+                else "replicated_vars"] += 1
+            sec["vars"][var.name] = {
+                "bytes": nbytes, "per_device_bytes": per_dev,
+                "spec": str(spec)}
+    for sec in out.values():
+        sec["replicated_per_device_bytes"] = sec["total_bytes"]
+    out["total_bytes"] = sum(
+        out[s]["total_bytes"] for s in ("params", "opt_state", "grads"))
+    out["per_device_bytes"] = sum(
+        out[s]["per_device_bytes"]
+        for s in ("params", "opt_state", "grads"))
     out["replicated_per_device_bytes"] = out["total_bytes"]
     return out
 

@@ -103,6 +103,14 @@ def _measure_candidate(cand, *, seq_len, n_layer, d_model, n_head, vocab,
         policy = cand.get("policy")
         if policy and policy != "none":
             pt.memory_optimize(main_prog, policy=policy)
+    if "fsdp" in cand:
+        # the gather-vs-replicate schedule dimension: the executor's
+        # scan body honors program._fsdp, so a replicate candidate is
+        # measured truly replicated (meaningful only when the measuring
+        # executor is mesh-bound with an fsdp axis — the single-chip
+        # search times both spellings identically but still persists
+        # the winner's choice for memory_optimize(policy="auto"))
+        main_prog._fsdp = bool(cand["fsdp"])
     rng = np.random.default_rng(17)
     toks = rng.integers(0, vocab, (batch, seq_len)).astype(np.int64)
     feed = {"tokens": toks, "labels": np.roll(toks, -1, axis=1)}
@@ -142,8 +150,8 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
                   dtype="bfloat16", fused_head=True, steps=2, warmup=1,
                   repeats=3, budget_bytes=None, block_caps=None,
                   policies=POLICY_ORDER, accums=(1,), diag_ws=(256,),
-                  max_measure=8, learning_rate=1e-3, force=False,
-                  mode=None):
+                  fsdp_opts=(None,), max_measure=8, learning_rate=1e-3,
+                  force=False, mode=None):
     """Search (or serve from cache) the step schedule for one GPT shape.
 
     Returns a report dict: ``entry`` (the winning cache entry or None),
@@ -190,7 +198,8 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
     accums = tuple(a for a in accums if batch % a == 0)
     cands = schedule_candidates(seq_len, d_model // n_head, n_head,
                                 block_caps=block_caps, policies=policies,
-                                accums=accums or (1,), diag_ws=diag_ws)
+                                accums=accums or (1,), diag_ws=diag_ws,
+                                fsdp_opts=fsdp_opts)
     report["candidates"] = len(cands)
     hbm_model = lambda c: estimate_gpt_step_hbm(
         n_layer, d_model, n_head, vocab, seq_len, batch,
@@ -261,7 +270,7 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
         return report
     win = min(timed, key=lambda m: m["median_s"])
     config = {k: win[k] for k in ("block_q", "block_k", "diag_w",
-                                  "packed", "policy", "accum")
+                                  "packed", "policy", "accum", "fsdp")
               if k in win}
     meas = {k: win[k] for k in ("median_s", "tok_s", "flops",
                                 "bytes_accessed", "hbm_high_water_bytes",

@@ -133,9 +133,13 @@ def attention_candidates(seq_len, d_head, n_head, block_caps=None,
 
 def schedule_candidates(seq_len, d_head, n_head, block_caps=None,
                         policies=POLICY_ORDER, accums=(1, 2),
-                        diag_ws=(256,)):
+                        diag_ws=(256,), fsdp_opts=(None,)):
     """The step-schedule candidate list: kernel geometry x remat policy
-    x gradient-accumulation factor."""
+    x gradient-accumulation factor (x FSDP gather-vs-replicate when the
+    caller is tuning a mesh with an ``fsdp`` axis: ``fsdp_opts=(False,
+    True)`` adds the dimension — TVM-style, the schedule decision stays
+    inside the measured search instead of hardcoded; ``None`` entries
+    leave the key off the candidate, the single-chip default)."""
     out = []
     for geo in attention_candidates(seq_len, d_head, n_head,
                                     block_caps=block_caps,
@@ -143,10 +147,13 @@ def schedule_candidates(seq_len, d_head, n_head, block_caps=None,
                                     include_packed=False):
         for pol in policies:
             for acc in accums:
-                c = dict(geo)
-                c["policy"] = pol
-                c["accum"] = int(acc)
-                out.append(c)
+                for fs in fsdp_opts:
+                    c = dict(geo)
+                    c["policy"] = pol
+                    c["accum"] = int(acc)
+                    if fs is not None:
+                        c["fsdp"] = bool(fs)
+                    out.append(c)
     return out
 
 

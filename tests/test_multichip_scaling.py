@@ -170,24 +170,44 @@ def test_optimizer_state_report_static():
 
 
 # -- ZeRO-1 bit-exactness ---------------------------------------------------
-def test_zero_bitexact_adam_dp8():
+@pytest.mark.parametrize(
+    "axes", [{"dp": 8}, {"dp": 4, "tp": 2}, {"dp": 2, "fsdp": 2, "tp": 2}],
+    ids=["dp8", "dp4xtp2", "dp2xfsdp2xtp2"])
+def test_zero_bitexact_adam_dp8(axes):
     """ZeRO-1 sharded Adam state vs the replicated spelling on the SAME
-    dp=8 mesh: loss and updated params bit-exact (the gradient pin at
-    the backward/optimizer boundary isolates the backward from the
-    accumulator shardings), and the live moment arrays really are
-    dp-sharded."""
+    mesh — parameterized over dp, dp x tp, and dp x fsdp x tp: loss and
+    updated params bit-exact (the gradient pin at the backward/optimizer
+    boundary isolates the backward from the accumulator shardings), and
+    the live moment arrays really are sharded."""
     feed = _gpt_feed()
-    mesh = _mesh()
+    mesh = make_mesh(axes, devices=jax.devices()[:8])
+
+    def build():
+        main, startup, outs = _build_gpt()
+        if "tp" in axes:
+            for prog in (main, startup):
+                papi.shard_parameters_by_rule(
+                    prog, transformer.tp_rules())
+        if "fsdp" in axes:
+            papi.shard_fsdp(main, programs=(startup,))
+        return main, startup, outs
+
     lz, pz, _cost, _plan, main, state = _train(
-        lambda: _build_gpt(), feed, "avg_cost", mesh, zero=True)
+        build, feed, "avg_cost", mesh, zero=True)
     lr, pr, _cost_r, _plan_r, _main_r, _state_r = _train(
-        lambda: _build_gpt(), feed, "avg_cost", mesh, zero=False)
+        build, feed, "avg_cost", mesh, zero=False)
     for a, b in zip(lz, lr):
         assert np.array_equal(a, b)
     for k in pz:
         assert np.array_equal(pz[k], pr[k]), k
-    mom = next(n for n in sorted(state) if n.endswith("_moment1"))
-    assert "dp" in str(state[mom].sharding.spec)
+    moments = [n for n in sorted(state) if n.endswith("_moment1")]
+    sharded = [str(state[n].sharding.spec) for n in moments
+               if state[n].sharding.spec != P()]
+    assert sharded, moments
+    if "fsdp" not in axes:
+        assert any("dp" in s for s in sharded), sharded
+    else:
+        assert any("fsdp" in s for s in sharded), sharded
     beta = next(n for n in sorted(state) if n.startswith("beta1_pow"))
     assert state[beta].sharding.spec == P()
 
@@ -404,13 +424,20 @@ def test_multichip_bench_row():
     for k in ("dp1_step_ms", "dp_step_ms", "scaling_efficiency",
               "collective_bytes", "reduce_ops", "reduce_ops_in_loop",
               "opt_state_bytes_per_device", "opt_state_bytes_replicated",
-              "accum_plan"):
+              "accum_plan", "dp_fsdp_step_ms", "param_bytes_per_device",
+              "param_bytes_replicated", "fsdp_gathers_in_loop"):
         assert k in row, (k, row)
     assert not [k for k in row if k.startswith("gate_")], row
     assert row["reduce_ops_in_loop"] == 0
     assert row["opt_state_bytes_per_device"] * 4 <= row[
         "opt_state_bytes_replicated"]
     assert row["accum_plan"]["mode"] == "local"
+    # the FSDP gate facts: params sharded at rest, gathers in loop
+    assert row["param_bytes_per_device"] * 2 <= row[
+        "param_bytes_replicated"]
+    assert row["fsdp_gathers_in_loop"] > 0
+    assert row["fsdp_reduce_ops_in_loop"] == 0
+    assert row["fsdp_groups"] > 0
 
 
 def test_comm_overlap_flags(monkeypatch):
