@@ -112,7 +112,37 @@ def shard_batch(arrays, mesh):
     return [jax.device_put(a, sh) for a in arrays]
 
 
-def bench_resnet(n_chips, mesh_factory, steps, warmup):
+def _fold_attribution(exe, extra, prefix, measured_step_s=None):
+    """Fold the executor's per-op attribution table
+    (``observability.attribution``, built at compile time) into the
+    bench row: the per-class shares ``bench_history`` diffs to explain
+    regressions, the tune-style workload key the learned-cost-model
+    corpus joins on, and — when a measured step time is available — the
+    roofline model's error %."""
+    att = getattr(exe, "last_attribution", None)
+    if not att:
+        return
+    try:
+        from paddle_tpu.observability import attribution as _attr
+
+        extra[prefix + "attribution"] = {
+            "classes": {
+                c: {k: r.get(k) for k in
+                    ("flops", "bytes", "est_ms", "share", "bound")}
+                for c, r in att.get("classes", {}).items()},
+            "workload": att.get("workload"),
+            "coverage": att.get("coverage"),
+            "est_ms_total": att.get("est_ms_total"),
+        }
+        rec = _attr.reconcile(att, measured_step_s)
+        if rec:
+            extra[prefix + "attr_model_err_pct"] = rec["err_pct"]
+            extra[prefix + "attr_est_ms"] = rec["est_ms"]
+    except Exception:  # noqa: BLE001 — attribution must never kill a row
+        pass
+
+
+def bench_resnet(n_chips, mesh_factory, steps, warmup, extra=None):
     import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.models import resnet
@@ -138,6 +168,9 @@ def bench_resnet(n_chips, mesh_factory, steps, warmup):
                                   {"img": img, "label": label},
                                   [outs["avg_cost"]], steps, warmup)
     assert np.isfinite(cost[0]).all()
+    if extra is not None:
+        _fold_attribution(exe, extra, "resnet_",
+                          measured_step_s=dt / steps)
     rates = [batch * steps / t / n_chips for t in times]
     return batch * steps / dt / n_chips, min(rates), max(rates)
 
@@ -355,6 +388,10 @@ def _bench_gpt_at(seq, n_chips, mesh_factory, steps, warmup, extra):
     dt, times, cost = timed_steps(exe, main_prog, feed,
                                   [outs["avg_cost"]], steps, warmup)
     assert np.isfinite(cost[0]).all()
+    # per-op attribution of the compiled flagship step + the roofline
+    # model's error vs the measured step — one corpus row per bench
+    # round for the learned cost model (ROADMAP item 5c)
+    _fold_attribution(exe, extra, "gpt_", measured_step_s=dt / steps)
 
     tokens_per_s = batch * seq * steps / dt
     d_ff = 4 * d_model
@@ -750,7 +787,10 @@ def serving_rows(extra, timeout=900):
                          ("speedup", "serving_speedup"),
                          ("ttft_p50_ms", "serving_ttft_p50_ms"),
                          ("queue_wait_p50_ms",
-                          "serving_queue_wait_p50_ms")):
+                          "serving_queue_wait_p50_ms"),
+                         ("goodput_under_slo",
+                          "serving_goodput_under_slo"),
+                         ("slo_violations", "serving_slo_violations")):
             if isinstance(row.get(src), (int, float)):
                 extra[dst] = row[src]
         if "serving_tok_s" not in extra:
@@ -895,7 +935,7 @@ def _main(extra, errors):
     if "resnet" in which:
         try:
             img_per_chip, img_min, img_max = bench_resnet(
-                n_chips, mesh_factory, steps, warmup)
+                n_chips, mesh_factory, steps, warmup, extra=extra)
             extra["resnet_img_s_min"] = round(img_min, 1)
             extra["resnet_img_s_max"] = round(img_max, 1)
         except Exception as e:

@@ -16,13 +16,21 @@ bench discipline: never die without a parseable row):
     baseline_tok_s   same workload, sequential single-stream decode
     speedup          tok_s / baseline_tok_s
     ttft_p50/95/99_ms, e2e_p50/95/99_ms   per-request latency (handles)
+    goodput_under_slo  tokens/sec from requests that met their TTFT/e2e
+                     SLO budgets (``--ttft-slo-ms`` / ``--e2e-slo-ms``;
+                     engine-side accounting: ``ServingEngine``
+                     ``slo_violations`` counter + ``goodput_tok_s``
+                     gauge) — the ROADMAP 1(c) measurement: tok/s
+                     rewards serving nobody on time, goodput does not
+    slo_violations   requests that breached a budget
     prefill_compiles / decode_compiles / buckets   the compile bound:
                      executables == used prefill buckets + 1 decode
                      chunk, independent of request count
 
 ``--smoke`` is the CI gate (tools/tier1.sh): a CPU-sized config at
-concurrency >= 8 that ASSERTS the engine beats the sequential baseline
-and that the compile bound holds.
+concurrency >= 8 that ASSERTS the engine beats the sequential baseline,
+that the compile bound holds, and that the row carries
+``goodput_under_slo``.
 
 Usage:
     python benchmarks/serving.py --smoke
@@ -125,7 +133,9 @@ def run_engine(params, cfg, work, rate, rng):
     eng = ServingEngine(
         params, cfg["n_layer"], cfg["n_head"], cfg["d_model"],
         max_len=cfg["max_len"], max_slots=cfg["slots"],
-        decode_chunk=cfg["chunk"], min_bucket=cfg["min_bucket"])
+        decode_chunk=cfg["chunk"], min_bucket=cfg["min_bucket"],
+        ttft_slo_s=cfg["ttft_slo_ms"] / 1e3,
+        e2e_slo_s=cfg["e2e_slo_ms"] / 1e3)
     # warm: one tiny request per distinct bucket + the decode chunk
     seen = {}
     for p, _ in work:
@@ -142,6 +152,9 @@ def run_engine(params, cfg, work, rate, rng):
         h = get_registry().get(nm)
         if h is not None:
             h.reset()
+    # the warm requests' SLO verdicts (the first decode chunk is the
+    # compile) must not charge the timed run's goodput accounting
+    eng.reset_slo_accounting()
 
     prompts = [p for p, _ in work]
     max_new = [m for _, m in work]
@@ -162,7 +175,15 @@ def run_engine(params, cfg, work, rate, rng):
     st = eng.stats()
     ttft = np.asarray([r.ttft for r in reqs]) * 1e3
     e2e = np.asarray([r.e2e for r in reqs]) * 1e3
+    # goodput under SLO: tokens of requests that met their budgets over
+    # the same timed window tok_s uses — the two diverge exactly when
+    # the engine serves tokens nobody receives on time
+    good_toks = sum(len(r.tokens) for r in reqs if r.slo_ok)
     out = {"tok_s": sum(max_new) / wall, "wall_s": wall,
+           "goodput_under_slo": round(good_toks / wall, 1),
+           "slo_violations": int(st.get("serving.slo_violations", 0)),
+           "ttft_slo_ms": cfg["ttft_slo_ms"],
+           "e2e_slo_ms": cfg["e2e_slo_ms"],
            "prefill_compiles": int(st["serving.prefill_compiles"]),
            "decode_compiles": int(st["serving.decode_compiles"]),
            "buckets": sorted(seen),
@@ -190,28 +211,42 @@ def main():
     ap.add_argument("--slots", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ttft-slo-ms", type=float, default=None,
+                    help="per-request TTFT budget; breaches count "
+                    "slo_violations and drop from goodput_under_slo")
+    ap.add_argument("--e2e-slo-ms", type=float, default=None,
+                    help="per-request end-to-end budget")
     ap.add_argument("--no-baseline", action="store_true")
     args = ap.parse_args()
 
     if args.smoke:
         # sized so the batched-decode win is visible on a CPU backend:
         # wide head (the b=1 lm_head matmul is the single-stream path's
-        # wasted bandwidth), decode-heavy mix, concurrency 16
+        # wasted bandwidth), decode-heavy mix, concurrency 16.  SLO
+        # budgets are generous (CPU smoke measures plumbing, not
+        # latency): the gate is that the row CARRIES goodput, not that
+        # a laptop meets a production SLO.
         cfg = {"vocab": 8192, "n_layer": 2, "n_head": 8, "d_model": 512,
                "max_len": 64, "slots": 16, "chunk": 8, "min_bucket": 4,
                "classes": [(4, 44), (6, 56), (8, 48)], "requests": 24,
-               "dtype": "float32"}
+               "dtype": "float32",
+               "ttft_slo_ms": 60000.0, "e2e_slo_ms": 120000.0}
     else:
         cfg = {"vocab": 32768, "n_layer": 12, "n_head": 6, "d_model": 768,
                "max_len": 512, "slots": 32, "chunk": 16, "min_bucket": 16,
                "classes": [(16, 96), (32, 192), (64, 256), (24, 480)],
-               "requests": 64, "dtype": "bfloat16"}
+               "requests": 64, "dtype": "bfloat16",
+               "ttft_slo_ms": 2000.0, "e2e_slo_ms": 30000.0}
     if args.requests:
         cfg["requests"] = args.requests
     if args.slots:
         cfg["slots"] = args.slots
     if args.chunk:
         cfg["chunk"] = args.chunk
+    if args.ttft_slo_ms:
+        cfg["ttft_slo_ms"] = float(args.ttft_slo_ms)
+    if args.e2e_slo_ms:
+        cfg["e2e_slo_ms"] = float(args.e2e_slo_ms)
 
     row = _stamp({
         "metric": "serving_tok_s", "mode": "smoke" if args.smoke
@@ -246,6 +281,9 @@ def main():
             assert row["speedup"] > 1.0, \
                 (f"continuous batching did not beat sequential decode: "
                  f"{row}")
+            assert isinstance(row.get("goodput_under_slo"),
+                              (int, float)), \
+                f"row lacks goodput_under_slo: {row}"
     except Exception as e:  # noqa: BLE001 — the row must still print
         row["error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(row))

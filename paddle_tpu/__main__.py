@@ -658,6 +658,12 @@ def cmd_bench_history(argv):
               f"{r['artifact']} is {r['drop'] * 100:.1f}% below best "
               f"{r['best']:g} (round {r['best_round']})",
               file=sys.stderr)
+    for key, moved in sorted(
+            summary.get("regression_attribution", {}).items()):
+        tops = "; ".join(
+            f"{m['op_class']} share {m['share_best']} -> {m['share']}"
+            for m in moved[:3])
+        print(f"ATTRIBUTION: {key}: {tops}", file=sys.stderr)
     print(_json.dumps(summary))
     return 0 if summary["ok"] else 1
 
@@ -1148,6 +1154,217 @@ def cmd_lint_selftest(args=None):
     return 1 if failures else 0
 
 
+def cmd_attribution_selftest(args=None):
+    """``python -m paddle_tpu --attribution-selftest``: the per-op
+    attribution engine + crash flight recorder's CI gate, CPU-only —
+    the compiled GPT flagship-family step's attribution table must
+    cover >= 95% of the executable's own cost-analysis flops with sane
+    classes/shares and a tune-style workload key; the roofline
+    estimate-vs-measured step-time error is REPORTED (the corpus
+    quality figure — on CPU the roofline constants are nominal, so the
+    value is informational, its presence is the contract); an injected
+    NaN fault (``PADDLE_TPU_FAULT=nan_grad``, the PR-8 injection point)
+    and a tripped watchdog each produce a loadable flight bundle
+    containing the triggering step records; and a planted two-round
+    bench-history fixture's >10% regression is ATTRIBUTED to the op
+    class whose share moved.  Wired into tools/tier1.sh
+    (docs/observability.md)."""
+    import math
+    import tempfile
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observability import attribution as attr
+    from paddle_tpu.observability import bench_history as bh
+    from paddle_tpu.observability import flight
+
+    failures = []
+
+    def check(cond, what):
+        (failures.append(what) if not cond else None)
+        print(("ok   " if cond else "FAIL ") + what)
+
+    # -- attribution table on the GPT flagship config ------------------
+    # the flagship model FAMILY (transformer.build: flash attention,
+    # fused CE head, scan-remat under memory_optimize) at CPU-sized
+    # dims; ATTR_SELFTEST_* envs restore the full flagship shape on
+    # real hardware
+    n_layer = int(os.environ.get("ATTR_SELFTEST_LAYERS", "4"))
+    d_model = int(os.environ.get("ATTR_SELFTEST_DMODEL", "64"))
+    n_head = int(os.environ.get("ATTR_SELFTEST_HEADS", "2"))
+    seq = int(os.environ.get("ATTR_SELFTEST_SEQ", "128"))
+    vocab = int(os.environ.get("ATTR_SELFTEST_VOCAB", "512"))
+    pt.core.unique_name.reset()
+    main_prog, startup = pt.Program(), pt.Program()
+    main_prog.random_seed = 7
+    with pt.program_guard(main_prog, startup):
+        outs = transformer.build(
+            vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+            d_model=d_model, max_len=seq, dropout_rate=0.0,
+            dtype="float32")
+    pt.memory_optimize(main_prog, policy="selective")
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, vocab, (2, seq)).astype(np.int64)
+    feed = {"tokens": toks, "labels": np.roll(toks, -1, axis=1)}
+    cost = exe.compile_only(main_prog, feed=feed,
+                            fetch_list=[outs["avg_cost"]])
+    att = exe.last_attribution
+    check(att is not None and att.get("classes"),
+          "compile produced exe.last_attribution")
+    cov = (att or {}).get("coverage")
+    check(cov is not None and cov >= 0.95,
+          f"attribution covers >= 95% of compiled flops "
+          f"(coverage={cov})")
+    classes = (att or {}).get("classes", {})
+    check("matmul" in classes and "pallas" in classes,
+          f"table carries matmul + pallas kernel classes "
+          f"({sorted(classes)})")
+    share_sum = sum(r.get("share") or 0 for r in classes.values())
+    check(abs(share_sum - 1.0) < 0.02,
+          f"class shares sum to 1 ({share_sum:.4f})")
+    check(all(r.get("bound") in ("compute", "memory")
+              for r in classes.values()),
+          "every class classified compute- or memory-bound")
+    wk = (att or {}).get("workload") or ""
+    check(wk.startswith("op=step|") and "remat=selective" in wk,
+          f"tune-style workload key ({wk})")
+    summ = (cost or {}).get("attribution") or {}
+    check(bool(summ.get("top")) and summ.get("coverage") == cov,
+          "compact summary rides last_step_cost (trainer JSONL channel)")
+
+    # -- estimated vs measured step time -------------------------------
+    exe.run(main_prog, feed=feed, fetch_list=[outs["avg_cost"]])
+    t0 = time.perf_counter()
+    steps = 3
+    for _ in range(steps):
+        exe.run(main_prog, feed=feed, fetch_list=[outs["avg_cost"]])
+    measured = (time.perf_counter() - t0) / steps
+    rec = attr.reconcile(att, measured)
+    check(rec is not None and math.isfinite(rec["err_pct"]),
+          f"estimated-vs-measured step-time error reported "
+          f"(est {rec['est_ms'] if rec else '?'} ms vs measured "
+          f"{rec['measured_ms'] if rec else '?'} ms, "
+          f"err {rec['err_pct'] if rec else '?'}%)")
+
+    # -- flight recorder: injected NaN + watchdog trips ----------------
+    tmpd = tempfile.mkdtemp(prefix="pt_flight_")
+    old_rec = flight.set_recorder(flight.FlightRecorder(out_dir=tmpd))
+    try:
+        pt.core.unique_name.reset()
+        mp2, sp2 = pt.Program(), pt.Program()
+        with pt.program_guard(mp2, sp2):
+            x = layers.data("x", shape=[8])
+            yv = layers.data("y", shape=[1])
+            h = layers.fc(x, 8, act="relu")
+            loss2 = layers.reduce_mean(
+                layers.square(layers.fc(h, 1) - yv))
+            pt.optimizer.SGD(learning_rate=0.1).minimize(loss2)
+            trainer = pt.trainer.Trainer(loss2, [x, yv])
+            rng2 = np.random.default_rng(0)
+
+            def reader():
+                for _ in range(4):
+                    yield [(rng2.normal(size=(8,)).astype(np.float32),
+                            rng2.normal(size=(1,)).astype(np.float32))
+                           for _ in range(4)]
+
+            os.environ["PADDLE_TPU_FAULT"] = "nan_grad:3"
+            try:
+                trainer.train(reader, num_passes=1)
+            finally:
+                os.environ.pop("PADDLE_TPU_FAULT", None)
+        rec_obj = flight.get_recorder()
+        nan_dumps = [p for p in rec_obj.dumps if "nan_trip" in p]
+        check(bool(nan_dumps),
+              f"injected nan_grad fault dumped a flight bundle "
+              f"({rec_obj.dumps})")
+        if nan_dumps:
+            b = flight.load_bundle(nan_dumps[0])
+            steps_in = b.get("steps", [])
+            trig = [s for s in steps_in
+                    if isinstance(s.get("loss"), float)
+                    and math.isnan(s["loss"])]
+            check(bool(trig),
+                  f"bundle contains the triggering (NaN-loss) step "
+                  f"({len(steps_in)} step records)")
+            check(bool(b.get("grad_norm_window")),
+                  f"bundle carries the grad-norm window "
+                  f"({len(b.get('grad_norm_window', []))} entries)")
+            check(b.get("reason") == "nan_trip" and b.get("spans")
+                  is not None and b.get("metrics") is not None,
+                  "bundle carries reason/spans/metrics")
+
+        from paddle_tpu.resilience.watchdog import Watchdog
+
+        wd = Watchdog(deadline=0.15, label="attr-selftest")
+        time.sleep(0.8)
+        wd.stop()
+        wd_dumps = [p for p in flight.get_recorder().dumps
+                    if "watchdog" in p]
+        check(bool(wd_dumps),
+              "watchdog trip dumped a loadable flight bundle")
+        if wd_dumps:
+            b = flight.load_bundle(wd_dumps[0])
+            check(b.get("reason") == "watchdog"
+                  and b.get("context", {}).get("age_s") is not None,
+                  "watchdog bundle carries the stall age")
+    finally:
+        flight.set_recorder(old_rec)
+
+    # -- regression attribution on a planted two-round fixture ---------
+    import json as _json
+
+    fixture = tempfile.mkdtemp(prefix="pt_attr_hist_")
+
+    def _att_extra(shares):
+        return {"classes": {c: {"flops": 1, "bytes": 1, "est_ms": s,
+                                "share": s, "bound": "memory"}
+                            for c, s in shares.items()},
+                "workload": "op=step|t=16384|dh=128|h=6|dt=bfloat16"
+                            "|plat=tpu|remat=auto",
+                "coverage": 0.99, "est_ms_total": 1.0}
+
+    rows_fx = [
+        ("BENCH_r01.json", {"n": 1, "rc": 0, "parsed": {
+            "metric": "gpt_train_tokens_per_sec_per_chip",
+            "value": 100.0, "unit": "tok/s",
+            "extra": {"gpt_attribution": _att_extra(
+                {"matmul": 0.6, "elementwise": 0.3,
+                 "collective.all-reduce": 0.1})}}}),
+        ("BENCH_r02.json", {"n": 2, "rc": 0, "parsed": {
+            "metric": "gpt_train_tokens_per_sec_per_chip",
+            "value": 42.0, "unit": "tok/s",
+            "extra": {"gpt_attribution": _att_extra(
+                {"matmul": 0.35, "elementwise": 0.25,
+                 "collective.all-reduce": 0.4})}}}),
+    ]
+    for name, data in rows_fx:
+        with open(os.path.join(fixture, name), "w") as fh:
+            _json.dump(data, fh)
+    summary, _rows = bh.history(fixture)
+    regs = summary["regressions"]
+    check(bool(regs), "planted >10% regression flagged")
+    ra = summary.get("regression_attribution", {})
+    key = ("BENCH_r02.json:gpt_train_tokens_per_sec_per_chip")
+    moved = ra.get(key) or []
+    check(bool(moved) and moved[0]["op_class"]
+          == "collective.all-reduce",
+          f"regression attributed to the op class whose share moved "
+          f"({[m['op_class'] for m in moved]})")
+
+    print("attribution selftest " + ("FAILED" if failures else "PASSED"))
+    return 1 if failures else 0
+
+
 def cmd_tune_selftest(args=None):
     """``python -m paddle_tpu --tune-selftest``: the autotune engine's
     CI gate, CPU-only — a miniature measured schedule search over a toy
@@ -1198,6 +1415,8 @@ def main(argv=None):
         return cmd_resilience_selftest()
     if "--tune-selftest" in argv:
         return cmd_tune_selftest()
+    if "--attribution-selftest" in argv:
+        return cmd_attribution_selftest()
     if "--bench-history" in argv:
         return cmd_bench_history(argv)
     if "--lint" in argv:

@@ -34,10 +34,10 @@ import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 
 from ..observability import metrics as _obs
-from .memaudit import BLOCK_INPUT_TAG, KERNEL_RESIDUAL_TAG
+from ..analysis.jaxpr_tools import BLOCK_INPUT_TAG, KERNEL_RESIDUAL_TAG
 from .program import Program, Parameter, default_main_program, GRAD_SUFFIX
 from .registry import get_op_impl
-from .scope import Scope, global_scope, RNG_VAR
+from .scope import Scope, global_scope, GRAD_NORM_VAR, RNG_VAR
 from .place import CPUPlace, TPUPlace
 
 _pinned_host_cache = []
@@ -90,6 +90,28 @@ def _offload_ckpt_policy(mode):
             offload_src="device", offload_dst="pinned_host")
     return cp.save_only_these_names(
         KERNEL_RESIDUAL_TAG, BLOCK_INPUT_TAG)
+
+
+def _grad_norm_enabled():
+    """Training-dynamics telemetry kill switch: ``PADDLE_TPU_GRADNORM=0``
+    drops the global grad-norm output from the step entirely (the scope
+    never grows the ``@GRAD_NORM@`` entry and the compiled step is
+    byte-identical to the pre-telemetry spelling)."""
+    return os.environ.get("PADDLE_TPU_GRADNORM", "1").lower() not in (
+        "0", "", "false", "off", "no")
+
+
+def _emits_grad_norm(program):
+    """True when the step function for ``program`` will emit the
+    ``@GRAD_NORM@`` state entry: a marked backward exists and the kill
+    switch is on.  ``_prepare`` (carry structure), ``compile_shardings``
+    (pytree match) and ``lower`` (the emission itself) must all agree —
+    this predicate is the single source of that decision."""
+    if not _grad_norm_enabled():
+        return False
+    block = program.global_block()
+    return (block.backward_index is not None
+            and program._backward_info.get(0) is not None)
 
 
 def _scan_strict():
@@ -401,6 +423,11 @@ class Executor:
         # ({"mode": "local"|"reduce_each", ...}) — the accumulation
         # analogue of last_remat_plan.  None when the step has no accum.
         self.last_accum_plan = None
+        # Most recent compile's per-op-class attribution table
+        # (observability.attribution: flops/bytes/roofline-ms per class,
+        # coverage vs cost_analysis, tune-style workload key).  None
+        # until a compile runs with PADDLE_TPU_ATTR on.
+        self.last_attribution = None
 
     def _fsdp_active(self, program):
         """True when the scan-remat body should gather FSDP-sharded
@@ -561,6 +588,24 @@ class Executor:
                 cost["tune"] = ts
         except Exception:  # noqa: BLE001 — telemetry must never block
             pass
+        try:
+            # per-op-class performance attribution of this executable
+            # (observability/attribution.py): which classes own the
+            # milliseconds, coverage vs the cost_analysis figure above,
+            # and the tune-style workload key the corpus joins on.  The
+            # full table lands on exe.last_attribution; the compact
+            # top-op summary rides the cost dict into trainer JSONL and
+            # bench rows.  PADDLE_TPU_ATTR=0 skips the walk.
+            from ..observability import attribution as _attr
+
+            if _attr.attribution_enabled():
+                att = _attr.attribute_compiled(
+                    compiled, cost=cost, program=program)
+                if att:
+                    self.last_attribution = att
+                    cost["attribution"] = _attr.summarize(att)
+        except Exception:  # noqa: BLE001 — telemetry must never block
+            pass
         from ..analysis import compile_findings, lint_enabled
 
         if program is not None and lint_enabled():
@@ -644,6 +689,13 @@ class Executor:
         )
         state = {n: scope.get(n) for n in state_names}
         state[RNG_VAR] = scope.get(RNG_VAR)
+        if _emits_grad_norm(program):
+            # grad-norm is carried like @RNG@: output-only for run(),
+            # but lax.scan (run_steps) needs carry-in == carry-out, so
+            # the input state holds a (ignored) scalar slot too
+            if scope.find_var(GRAD_NORM_VAR) is None:
+                scope.set(GRAD_NORM_VAR, jnp.zeros((), jnp.float32))
+            state[GRAD_NORM_VAR] = scope.get(GRAD_NORM_VAR)
 
         feed_sig = tuple(
             (n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_vals)
@@ -673,6 +725,12 @@ class Executor:
 
                     _trace.get_tracer().instant(
                         "nan_guard_trip", cat="executor", var=name)
+                    # post-mortem: the flight bundle carries the recent
+                    # step records (grad-norm window included) alongside
+                    # the abort
+                    from ..observability import flight as _flight
+
+                    _flight.dump("nan_trip", var=name)
                     err = FloatingPointError(
                         f"NaN/Inf detected in {name!r} after step"
                     )
@@ -861,6 +919,8 @@ class Executor:
             in_sh, out_sh = compile_shardings(
                 self.mesh, program, feed_names, fetch_names, state_names,
                 out_state_names=persist_out,
+                extra_state=((GRAD_NORM_VAR,)
+                             if _emits_grad_norm(program) else ()),
             )
             state_sh, *feed_sh = in_sh
             # stacked feeds get an unsharded leading steps axis
@@ -883,6 +943,7 @@ class Executor:
         block = program.global_block()
         bw = block.backward_index
         info = program._backward_info.get(0)
+        emit_grad_norm = _emits_grad_norm(program)
         # The state the step returns: persistables that are either already
         # live (passed in) or written by some op — static, so sharding
         # pytrees can be built to match.
@@ -903,7 +964,9 @@ class Executor:
             step_key, next_key = jax.random.split(rng)
             ctx = LoweringCtx(self, program, step_key)
             env = dict(state)
+            env.pop(GRAD_NORM_VAR, None)  # carried slot, never an input
             env.update(zip(feed_names, feed_vals))
+            grad_norm_out = [None]
 
             if bw is None or info is None:
                 run_block_ops(ctx, block, block.ops, env)
@@ -1379,6 +1442,17 @@ class Executor:
                         program, block, ctx, env, tparams, make_fwd,
                         feed_names, persist_out, accum, step_key, bw)
                     env.update(aux)
+                if emit_grad_norm:
+                    # global grad norm BEFORE the boundary pin reads the
+                    # same values either way; computing it from the dict
+                    # here (one f32 sum-of-squares per param + one sqrt)
+                    # keeps it a pure extra output — nothing feeds back
+                    # into the update math, so every bit-exactness
+                    # contract is untouched
+                    parts = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in grads.values()]
+                    grad_norm_out[0] = (jnp.sqrt(sum(parts)) if parts
+                                        else jnp.zeros((), jnp.float32))
                 if self.mesh is not None:
                     # Pin each gradient at the backward/optimizer boundary
                     # to its PARAMETER's sharding (replicated under plain
@@ -1420,6 +1494,11 @@ class Executor:
 
             new_state = {n: env[n] for n in persist_out}
             new_state[RNG_VAR] = next_key
+            if emit_grad_norm:
+                new_state[GRAD_NORM_VAR] = (
+                    grad_norm_out[0]
+                    if grad_norm_out[0] is not None
+                    else jnp.zeros((), jnp.float32))
             fetches = tuple(env[n] for n in fetch_names)
             return new_state, fetches
 
@@ -1790,6 +1869,8 @@ class Executor:
             in_shardings, out_shardings = compile_shardings(
                 self.mesh, program, feed_names, fetch_names, state_names,
                 out_state_names=persist_out,
+                extra_state=((GRAD_NORM_VAR,)
+                             if _emits_grad_norm(program) else ()),
             )
             # NamedShardings carry the mesh, so no ambient mesh context is
             # needed around the jitted call.

@@ -14,6 +14,11 @@ import jax
 import numpy as np
 
 RNG_VAR = "@RNG@"
+# the step's global gradient norm, emitted by the Executor alongside the
+# state (training-dynamics telemetry: trainer.grad_norm gauge, JSONL,
+# flight-recorder NaN window); like @RNG@ it is scope state, not a
+# Program variable
+GRAD_NORM_VAR = "@GRAD_NORM@"
 
 
 class Scope:

@@ -206,6 +206,7 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
         if policy == "none":
             program._offload = False
             program._remat_segments = []
+            program._remat_policy = "none"
             return []
     block = program.global_block()
     if policy not in ("selective", "compact", "full", "offload"):
@@ -215,6 +216,10 @@ def memory_optimize(input_program=None, num_segments=None, min_segment=2,
     # the offload flag rides on the program (the Executor's scan body
     # reads it); segmentation below is exactly selective's
     program._offload = policy == "offload"
+    # the resolved policy label rides on the program: the attribution
+    # engine's workload key carries it (observability/attribution.py),
+    # matching the tune cache's remat dimension
+    program._remat_policy = policy
     policy_label = policy
     if policy == "offload":
         policy = "selective"

@@ -22,7 +22,13 @@ Modules:
 * ``bench_history`` — BENCH_*/MULTICHIP_* artifact trajectory: failed-
   artifact classification + best-so-far regression flagging (the
   ``python -m paddle_tpu --bench-history`` CI gate), plus `run_stamp`
-  (schema_version / run_id / git sha) every bench row carries.
+  (schema_version / run_id / git sha) every bench row carries;
+* ``attribution`` — per-op-class performance attribution over every
+  compiled step's HLO (flops/bytes/roofline ms per class,
+  ``exe.last_attribution``; the learned-cost-model corpus);
+* ``flight`` — the crash flight recorder: a bounded ring of recent
+  step records dumped as one post-mortem JSON bundle on watchdog /
+  NaN / OOM / driver-death / trainer-exception trips.
 
 Quick start::
 
@@ -34,8 +40,12 @@ Quick start::
     print(get_registry().to_text())   # or start_metrics_server(9464)
 """
 
-from . import bench_history, hardware, metrics, reporter, runlog, trace
+from . import (
+    attribution, bench_history, flight, hardware, metrics, reporter,
+    runlog, trace,
+)
 from .bench_history import run_stamp
+from .flight import FlightRecorder, get_recorder, set_recorder
 from .hardware import (
     device_memory_stats, device_peak_flops, mfu, sample_memory,
     total_peak_flops,
@@ -50,9 +60,11 @@ from .trace import Tracer, get_tracer, set_tracer
 
 __all__ = [
     "metrics", "runlog", "hardware", "reporter", "trace", "bench_history",
+    "attribution", "flight",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "start_metrics_server", "RunLog", "read_jsonl", "MetricsReporter",
     "device_peak_flops", "total_peak_flops", "mfu",
     "device_memory_stats", "sample_memory",
     "Tracer", "get_tracer", "set_tracer", "run_stamp",
+    "FlightRecorder", "get_recorder", "set_recorder",
 ]

@@ -72,7 +72,12 @@ _EXTRA_METRICS = (
     "gpt_t16k_tune_tok_s",
 )
 _MULTICHIP_METRICS = ("scaling_efficiency", "param_bytes_per_device")
-_SERVING_METRICS = ("tok_s", "speedup")
+_SERVING_METRICS = ("tok_s", "speedup", "goodput_under_slo")
+
+# a per-class share has to move at least this much (absolute) before
+# the regression attribution names it — sub-2% wiggle is measurement
+# noise, not an explanation
+_ATTR_SHARE_EPS = 0.02
 # surfaced in the trajectory table but EXEMPT from regression flagging,
 # each with its root-caused reason (ROADMAP known-regression triage):
 _REGRESSION_EXEMPT = {
@@ -197,7 +202,8 @@ def classify_artifact(path):
     row = {"artifact": name, "kind": kind, "round": 0, "rc": None,
            "ok": True, "reasons": [], "metrics": {},
            "run_id": None, "git_sha": None,
-           "t16k_class": False, "t16k_evidence": False}
+           "t16k_class": False, "t16k_evidence": False,
+           "attribution": {}}
     try:
         with open(path, "r", encoding="utf-8") as fh:
             data = json.load(fh)
@@ -249,6 +255,19 @@ def classify_artifact(path):
                     row["metrics"][f"serving_{k}"] = float(v)
             row["t16k_evidence"] = any(
                 k.startswith(_T16K_EVIDENCE_PREFIX) for k in extra)
+            # per-op attribution tables riding the row (bench.py
+            # _fold_attribution): keep each model's {class: share} map
+            # so a flagged regression can be ATTRIBUTED by diffing the
+            # two rounds' tables instead of just named
+            from .attribution import share_table
+
+            for akey in ("gpt_attribution", "resnet_attribution",
+                         "attribution"):
+                shares = share_table(extra.get(akey))
+                if shares:
+                    row["attribution"][
+                        akey.replace("_attribution", "")
+                        or "attribution"] = shares
         if row["reasons"]:
             # rot-class the failure: the t=16k OOM signature — the
             # 16384 sequence length TOGETHER with an allocator-dump
@@ -303,16 +322,52 @@ def history(root, threshold=0.1, known_failures=None):
     for metric, points in sorted(series.items()):
         if metric in _REGRESSION_EXEMPT:
             continue
-        best, best_at = None, None
+        best, best_at, best_artifact = None, None, None
         for rnd, artifact, value in points:
             if best is not None and value < best * (1.0 - threshold):
                 regressions.append({
                     "metric": metric, "round": rnd, "artifact": artifact,
                     "value": value, "best": best, "best_round": best_at,
+                    "best_artifact": best_artifact,
                     "drop": round(1.0 - value / best, 4),
                 })
             if best is None or value > best:
-                best, best_at = value, rnd
+                best, best_at, best_artifact = value, rnd, artifact
+    # ATTRIBUTE each flagged regression: diff the regressed artifact's
+    # per-op-class share table against the best round's and name the
+    # classes whose share moved — "tok/s dropped 14% and the collective
+    # share doubled" is actionable; a bare percentage is not.  Keyed
+    # "artifact:metric" like the regression acks.
+    att_of = {r["artifact"]: r.get("attribution") or {} for r in rows}
+    regression_attribution = {}
+    for r in regressions:
+        if r["metric"].startswith("serving"):
+            # no attribution table exists for the serving engine's
+            # compiled programs — diffing the TRAINING step's shares
+            # would confidently misdirect triage, so emit nothing
+            continue
+        model = "resnet" if "resnet" in r["metric"] else "gpt"
+        now_sh = (att_of.get(r["artifact"], {}).get(model)
+                  or att_of.get(r["artifact"], {}).get("attribution"))
+        ref_sh = (att_of.get(r.get("best_artifact"), {}).get(model)
+                  or att_of.get(r.get("best_artifact"), {}).get(
+                      "attribution"))
+        if not (isinstance(now_sh, dict) and isinstance(ref_sh, dict)):
+            continue
+        moved = []
+        for cls in sorted(set(now_sh) | set(ref_sh)):
+            delta = (now_sh.get(cls) or 0.0) - (ref_sh.get(cls) or 0.0)
+            if abs(delta) >= _ATTR_SHARE_EPS:
+                moved.append({
+                    "op_class": cls,
+                    "share_best": ref_sh.get(cls),
+                    "share": now_sh.get(cls),
+                    "delta": round(delta, 4),
+                })
+        if moved:
+            moved.sort(key=lambda m: -abs(m["delta"]))
+            regression_attribution[
+                f"{r['artifact']}:{r['metric']}"] = moved
     failed = [r["artifact"] for r in rows if not r["ok"]]
     # un-ack by evidence: a FAILED artifact of the t=16k rot class is
     # RESOLVED — no ack needed — once a later-round BENCH artifact ships
@@ -371,6 +426,7 @@ def history(root, threshold=0.1, known_failures=None):
         "resolved": resolved,
         "stale_acks": stale_acks,
         "regressions": regressions,
+        "regression_attribution": regression_attribution,
         "ok": not unacknowledged,
     }
     return summary, rows

@@ -16,8 +16,9 @@ import os
 from . import metrics as _metrics
 
 __all__ = [
-    "PEAK_BF16", "device_peak_flops", "total_peak_flops", "mfu",
-    "device_memory_stats", "sample_memory", "device_hbm_bytes",
+    "PEAK_BF16", "HBM_BW", "device_peak_flops", "total_peak_flops",
+    "mfu", "device_memory_stats", "sample_memory", "device_hbm_bytes",
+    "device_hbm_bandwidth",
 ]
 
 # bf16 peak FLOP/s by device_kind substring (public chip specs); order
@@ -27,10 +28,21 @@ PEAK_BF16 = (
     ("v6", 918e12), ("v4", 275e12), ("v3", 123e12),
 )
 
+# HBM bandwidth (bytes/s) by the same device_kind substrings (public
+# chip specs) — the memory side of the attribution engine's roofline
+# (observability.attribution): est_ms = max(flops/peak, bytes/bw)
+HBM_BW = (
+    ("v5 lite", 819e9), ("v5e", 819e9), ("v5p", 2765e9),
+    ("v6", 1640e9), ("v4", 1228e9), ("v3", 900e9),
+)
+
 # Nominal CPU peak so MFU stays defined on CPU runs (dev loops, CI).
 # Absolute CPU MFU is not meaningful against this — only step-to-step
 # deltas are; override with PT_CPU_PEAK_FLOPS.
 _CPU_NOMINAL_PEAK = 1e12
+
+# Nominal CPU memory bandwidth (same caveat; PT_CPU_HBM_BW to override)
+_CPU_NOMINAL_BW = 50e9
 
 
 def device_peak_flops(device=None):
@@ -50,6 +62,28 @@ def device_peak_flops(device=None):
         return float(os.environ.get("PT_CPU_PEAK_FLOPS",
                                     _CPU_NOMINAL_PEAK))
     return float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+
+
+def device_hbm_bandwidth(device=None):
+    """HBM bandwidth in bytes/s for one device — the memory axis of
+    the attribution roofline.  Chip-spec table by device_kind, then the
+    BENCH_HBM_BW env override for unknown accelerators, then a nominal
+    CPU constant (PT_CPU_HBM_BW) so the estimate is always computable
+    (CPU figures are only meaningful relative to each other)."""
+    if device is None:
+        try:
+            import jax
+
+            device = jax.devices()[0]
+        except Exception:
+            device = None
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, bw in HBM_BW:
+        if sub in kind:
+            return bw
+    if getattr(device, "platform", "cpu") == "cpu":
+        return float(os.environ.get("PT_CPU_HBM_BW", _CPU_NOMINAL_BW))
+    return float(os.environ.get("BENCH_HBM_BW", 819e9))
 
 
 def total_peak_flops(mesh=None, device=None):

@@ -47,6 +47,10 @@ class MetricsReporter:
         self._pass_t0 = None
         self._pass_samples = 0
         self._last_mem = {}
+        # training-dynamics window: recent losses for the spike z-score
+        import collections
+
+        self._loss_window = collections.deque(maxlen=64)
         if self.runlog is not None:
             self.runlog.log("run_meta", **(run_meta or {}))
 
@@ -91,12 +95,31 @@ class MetricsReporter:
                 self._steps_total == 1:
             self._last_mem = _hardware.sample_memory(reg)
 
+        # training dynamics: loss-spike z-score over the recent-loss
+        # window (mean/std of the PREVIOUS window, so a spike judges
+        # against history, not against itself) + the step's grad norm
+        loss_z = self._loss_zscore(ev.cost)
+        grad_norm = getattr(ev, "grad_norm", None)
+        if loss_z is not None:
+            reg.gauge("trainer.loss_zscore",
+                      help="z-score of this step's loss vs the recent "
+                           "window (spike detector)").set(loss_z)
+
         # the Executor reports its compile/cache counters to the GLOBAL
         # registry regardless of which registry this reporter writes to
         compile_count = int(
             _metrics.get_registry().value("executor.compile_count"))
         if self.runlog is not None:
             sc = getattr(ev, "step_cost", None) or {}
+            att = sc.get("attribution") or {}
+            # roofline-model error: the attribution engine's estimated
+            # step ms vs this step's measured wall — the model-quality
+            # figure every corpus row ships; ONE formula
+            # (attribution.reconcile) serves the JSONL and bench rows
+            from . import attribution as _attr
+
+            rec = _attr.reconcile(att, wall) if att else None
+            attr_err = rec["err_pct"] if rec else None
             self.runlog.log(
                 "step",
                 pass_id=ev.pass_id, batch_id=ev.batch_id,
@@ -137,10 +160,52 @@ class MetricsReporter:
                     "checkpoint.last_bytes"),
                 checkpoint_saves=self._resil_value("checkpoint.saves"),
                 resume_count=self._resil_value("executor.resume_count"),
+                # training dynamics (docs/observability.md): global grad
+                # norm + loss-spike z-score — the flight recorder's NaN
+                # window reads the same stream
+                grad_norm=grad_norm,
+                loss_zscore=loss_z,
+                # per-op attribution summary of the compiled step
+                # (observability/attribution.py): top classes by
+                # estimated time, the roofline total, coverage vs
+                # cost_analysis, and the estimate-vs-measured error —
+                # one learned-cost-model corpus row per step record
+                attr_top=att.get("top"),
+                attr_est_ms=att.get("est_ms_total"),
+                attr_coverage=att.get("coverage"),
+                attr_workload=att.get("workload"),
+                attr_model_err_pct=attr_err,
             )
         if self.log_every_n and ev.batch_id % self.log_every_n == 0:
             self._print(self._summary_line(ev, wall, throughput, mfu_v,
                                            compile_count))
+
+    def _loss_zscore(self, cost):
+        """z-score of this step's loss against the PREVIOUS window's
+        mean/std (so a spike is judged against history); None until the
+        window holds 8 samples or while the std is ~0.  NaN losses skip
+        the window (they would poison the statistics the next real
+        steps are judged by)."""
+        import math
+
+        try:
+            c = float(cost)
+        except (TypeError, ValueError):
+            return None
+        if not math.isfinite(c):
+            # a NaN/Inf loss gets no z-score (NaN would poison the
+            # gauge and emit non-strict JSON) and skips the window
+            return None
+        z = None
+        n = len(self._loss_window)
+        if n >= 8:
+            mean = sum(self._loss_window) / n
+            var = sum((x - mean) ** 2 for x in self._loss_window) / n
+            std = math.sqrt(var)
+            if std > 1e-12:
+                z = round((c - mean) / std, 4)
+        self._loss_window.append(c)
+        return z
 
     @staticmethod
     def _resil_value(name):

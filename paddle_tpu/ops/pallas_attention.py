@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 
-from ..core.memaudit import KERNEL_RESIDUAL_TAG
+from ..analysis.jaxpr_tools import KERNEL_RESIDUAL_TAG
 
 # The backward residual contract, pinned by tests/test_memory_engine.py:
 # the custom VJP recomputes p from EXACTLY these five arrays and closes

@@ -34,7 +34,7 @@ import numpy as np
 
 from jax.ad_checkpoint import checkpoint_name
 
-from ..core.memaudit import KERNEL_RESIDUAL_TAG
+from ..analysis.jaxpr_tools import KERNEL_RESIDUAL_TAG
 from ..core.registry import register_op
 from .pallas_attention import _pick_block
 

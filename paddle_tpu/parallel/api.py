@@ -235,11 +235,13 @@ def _spec_for(var, mesh, block=None):
 
 
 def compile_shardings(mesh, program, feed_names, fetch_names, state_names,
-                      out_state_names=None):
+                      out_state_names=None, extra_state=()):
     """Build (in_shardings, out_shardings) for the Executor's step signature
     step(state_dict, *feed) -> (new_state_dict, fetch_tuple).
     ``out_state_names`` may differ from ``state_names`` (e.g. the startup
-    program *creates* persistables it was not passed)."""
+    program *creates* persistables it was not passed).  ``extra_state``
+    names non-Program scope entries the step carries alongside ``@RNG@``
+    (e.g. ``@GRAD_NORM@``) — replicated scalars in both directions."""
     block = program.global_block()
 
     def ns(spec):
@@ -256,6 +258,9 @@ def compile_shardings(mesh, program, feed_names, fetch_names, state_names,
 
     out_state = {n: var_sharding(n) for n in (out_state_names or state_names)}
     out_state[RNG_VAR] = ns(P())
+    for n in extra_state:
+        state_shardings[n] = ns(P())
+        out_state[n] = ns(P())
     # fetches: replicate (they're pulled to host anyway)
     fetch_shardings = tuple(ns(P()) for _ in fetch_names)
     return (state_shardings, *feed_shardings), (out_state, fetch_shardings)

@@ -99,6 +99,20 @@ class Watchdog:
                         self.on_trip(age)
                     except Exception:
                         pass  # a broken callback must not kill the monitor
+                try:
+                    # post-mortem: a stalled loop dumps the flight
+                    # bundle (the last N step records + spans) so the
+                    # hang has history, not just a gauge.  After
+                    # on_trip: the dump does file IO, and callers
+                    # watching `trips` must not observe the increment
+                    # long before their callback runs.
+                    from ..observability import flight as _flight
+
+                    _flight.dump("watchdog", label=self.label,
+                                 age_s=round(age, 3),
+                                 deadline_s=self.deadline)
+                except Exception:  # noqa: BLE001 — monitor must survive
+                    pass
 
     def stop(self):
         self._stop.set()

@@ -47,6 +47,7 @@ import time
 
 import numpy as np
 
+from ..observability import flight as _flight
 from ..observability import metrics as _obs
 from ..observability import trace as _trace
 from . import batched_decode as _bd
@@ -68,7 +69,7 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens",
                  "submit_t", "first_token_t", "finish_t", "error",
                  "admit_t", "prefill_t0", "prefill_t1", "bucket",
-                 "chunks", "_done")
+                 "chunks", "slo_ok", "_done")
 
     def __init__(self, rid, prompt, max_new, eos_id):
         self.rid = rid
@@ -89,6 +90,9 @@ class Request:
         self.prefill_t1 = None
         self.bucket = None
         self.chunks = []
+        # SLO verdict at finish: True (met), False (violated), or None
+        # (the engine has no SLO budgets configured)
+        self.slo_ok = None
         self._done = threading.Event()
 
     @property
@@ -138,6 +142,14 @@ class ServingEngine:
     min_bucket    smallest prefill bucket; prompts pad to the nearest
              power-of-two multiple of it (compile-count bound).
     eos_id   default EOS token id (per-request override in ``submit``).
+    ttft_slo_s / e2e_slo_s   per-request latency budgets (seconds).
+             When set, every finished request is judged at finish time
+             (``Request.slo_ok``): a breach counts
+             ``serving.slo_violations`` and its tokens are EXCLUDED
+             from the ``serving.goodput_tok_s`` gauge — throughput the
+             users actually experienced within budget, the
+             goodput-under-SLO measurement ROADMAP item 1(c) schedules
+             against (tok/s alone rewards serving nobody on time).
 
     Drive it synchronously (``generate_many`` / ``step`` +
     ``results``) or from a background thread (``start``/``stop``) with
@@ -147,7 +159,7 @@ class ServingEngine:
     def __init__(self, params, n_layer, n_head, d_model, max_len=128,
                  max_slots=8, decode_chunk=4, min_bucket=8, eos_id=None,
                  compute_dtype=None, eps=1e-5, donate=True,
-                 registry=None):
+                 registry=None, ttft_slo_s=None, e2e_slo_s=None):
         import jax
         import jax.numpy as jnp
 
@@ -165,6 +177,14 @@ class ServingEngine:
         self.eos_id = eos_id
         self._eps = eps
         self._donate = donate
+        if ttft_slo_s is not None and ttft_slo_s <= 0:
+            raise ValueError(f"ttft_slo_s must be > 0: {ttft_slo_s}")
+        if e2e_slo_s is not None and e2e_slo_s <= 0:
+            raise ValueError(f"e2e_slo_s must be > 0: {e2e_slo_s}")
+        self.ttft_slo_s = ttft_slo_s
+        self.e2e_slo_s = e2e_slo_s
+        self._good_tokens = 0       # tokens of SLO-met completions
+        self._first_submit_t = None  # goodput window opens here
         if compute_dtype is None:
             compute_dtype = infer_compute_dtype(params)
         self.compute_dtype = jnp.dtype(compute_dtype)
@@ -248,6 +268,8 @@ class ServingEngine:
             self._next_rid += 1
             req = Request(rid, prompt,  max_new,
                           self.eos_id if eos_id is None else eos_id)
+            if self._first_submit_t is None:
+                self._first_submit_t = req.submit_t
             self._queue.append(req)
             self._reg.gauge("serving.queue_depth").set(len(self._queue))
         return req
@@ -322,6 +344,12 @@ class ServingEngine:
             self._reg.counter("serving.aborted").inc(len(pending))
         for req in pending:
             req._done.set()
+        # post-mortem: the abort (device error mid-step or driver
+        # death) dumps the flight bundle — recent spans carry the
+        # request/decode timeline that led here
+        _flight.dump("serving_abort",
+                     error=f"{type(exc).__name__}: {exc}"[:300],
+                     failed_requests=len(pending))
 
     def run_until_idle(self):
         """Drive ``step`` until the queue and every slot are empty."""
@@ -613,10 +641,63 @@ class ServingEngine:
         req.finish_t = now
         self._reg.counter("serving.completed").inc()
         self._reg.histogram("serving.e2e_seconds").observe(req.e2e)
+        self._judge_slo(req, now)
         self._emit_request_trace(req)
         with self._qlock:
             self._completed.append(req)
         req._done.set()
+
+    def reset_slo_accounting(self):
+        """Re-open the goodput window and zero the violation counter —
+        benchmarks call this after their warm pass so compile-time TTFT
+        breaches don't charge the timed run."""
+        with self._qlock:
+            self._good_tokens = 0
+            self._first_submit_t = None
+        c = self._reg.get("serving.slo_violations")
+        if c is not None:
+            c.reset()
+        g = self._reg.get("serving.goodput_tok_s")
+        if g is not None:
+            g.reset()
+
+    def _judge_slo(self, req, now):
+        """SLO verdict at completion: a TTFT or e2e budget breach counts
+        ``serving.slo_violations``; tokens of SLO-met requests feed the
+        ``serving.goodput_tok_s`` gauge (good tokens over the window
+        since the first submit — what the fleet delivered WITHIN budget,
+        not what it merely emitted)."""
+        if self.ttft_slo_s is None and self.e2e_slo_s is None:
+            return
+        ok = True
+        if self.ttft_slo_s is not None and (
+                req.ttft is None or req.ttft > self.ttft_slo_s):
+            ok = False
+        if self.e2e_slo_s is not None and (
+                req.e2e is None or req.e2e > self.e2e_slo_s):
+            ok = False
+        req.slo_ok = ok
+        if not ok:
+            self._reg.counter(
+                "serving.slo_violations",
+                help="completed requests that breached their TTFT/e2e "
+                     "SLO budget").inc()
+        # _good_tokens/_first_submit_t are shared with submit() and
+        # reset_slo_accounting() (which zeroes them under _qlock from
+        # the caller's thread while the driver finishes requests) — the
+        # read-modify-write must hold the same lock or a reset can lose
+        # or resurrect warm-pass tokens
+        with self._qlock:
+            if ok:
+                self._good_tokens += len(req.tokens)
+            good, t0 = self._good_tokens, self._first_submit_t
+        window = now - t0 if t0 is not None else 0.0
+        if window > 0:
+            self._reg.gauge(
+                "serving.goodput_tok_s",
+                help="tokens/sec from SLO-met requests since the first "
+                     "submit (goodput under SLO, ROADMAP 1c)",
+            ).set(good / window)
 
     def _emit_request_trace(self, req):
         """Lay the finished request's span tree on its own timeline lane:

@@ -13,7 +13,8 @@ import numpy as np
 
 from .core.executor import Executor
 from .core.program import default_main_program, default_startup_program
-from .core.scope import RNG_VAR, global_scope
+from .core.scope import GRAD_NORM_VAR, RNG_VAR, global_scope
+from .observability import flight as _flight
 from .data_feeder import DataFeeder
 from .observability import hardware as _hardware
 from .observability import metrics as _obs
@@ -56,12 +57,15 @@ class EndIteration:
     * ``reader_wait`` — seconds this step stalled waiting on the input
       pipeline (prefetch queue empty);
     * ``step_cost``   — the Executor's ``last_step_cost`` dict
-      (compile_seconds, flops, bytes_accessed, cache_hit).
+      (compile_seconds, flops, bytes_accessed, cache_hit);
+    * ``grad_norm``   — the step's global gradient norm (the Executor's
+      ``@GRAD_NORM@`` state output; None for programs without a
+      backward or under ``PADDLE_TPU_GRADNORM=0``).
     """
 
     def __init__(self, pass_id, batch_id, cost, metrics, wall_time=None,
                  samples=None, throughput=None, mfu=None, reader_wait=None,
-                 step_cost=None):
+                 step_cost=None, grad_norm=None):
         self.pass_id = pass_id
         self.batch_id = batch_id
         self.cost = cost
@@ -72,6 +76,7 @@ class EndIteration:
         self.mfu = mfu
         self.reader_wait = reader_wait
         self.step_cost = step_cost
+        self.grad_norm = grad_norm
 
 
 class Trainer:
@@ -95,6 +100,7 @@ class Trainer:
         self._global_step = 0  # StepTraceAnnotation step_num across passes
         self._last_ckpt_step = 0  # last global step a step-checkpoint saved
         self.last_resume = None   # train-state dict of the last resume
+        self._nan_dumped = False  # one nan-trip flight bundle per trainer
 
     def init_params(self):
         self.exe.run(self.startup_program)
@@ -292,6 +298,7 @@ class Trainer:
                                          prefetched=bool(prefetch)):
                             feed = (item if prefetch
                                     else self.feeder.feed(item))
+                        t_feed = time.perf_counter()
                         # dispatch: compile-or-cache-hit + enqueue of
                         # the device step (async under jax; a compile
                         # shows up as a long first-dispatch span)
@@ -303,11 +310,13 @@ class Trainer:
                                 fetch_list=fetch,
                                 return_numpy=False,
                             )
+                        t_disp = time.perf_counter()
                         # device_sync: host blocks materializing
                         # fetches
                         with tracer.span("trainer.device_sync",
                                          cat="trainer"):
                             vals = [np.asarray(v) for v in vals]
+                        t_sync = time.perf_counter()
                         cost = float(vals[0].reshape(-1)[0])
                         if fault_action == "nan":
                             cost = float("nan")  # injected bad gradient
@@ -318,10 +327,15 @@ class Trainer:
                         with tracer.span("trainer.opt_boundary",
                                          cat="trainer"):
                             metrics = vals[1:]
+                            tele = self._step_telemetry(wall, feed)
                             event_handler(EndIteration(
                                 pass_id, batch_id, cost, metrics,
-                                reader_wait=reader_wait,
-                                **self._step_telemetry(wall, feed)))
+                                reader_wait=reader_wait, **tele))
+                    self._flight_step(
+                        pass_id, batch_id, cost, reader_wait, tele,
+                        phase_feed_h2d=t_feed - t0,
+                        phase_dispatch=t_disp - t_feed,
+                        phase_device_sync=t_sync - t_disp)
                     if wd is not None:
                         wd.beat()
                     self._step_checkpoint(
@@ -341,6 +355,12 @@ class Trainer:
                 self._pass_checkpoint(pass_id, ckpt, checkpoint_dir,
                                       checkpoint_every_n_passes)
                 event_handler(EndPass(pass_id))
+        except Exception as e:
+            # post-mortem: an exception escaping the train loop dumps
+            # the flight bundle (classified oom / nan_trip /
+            # trainer_exception) before propagating
+            self._flight_crash(e)
+            raise
         finally:
             if wd is not None:
                 wd.stop()
@@ -411,13 +431,78 @@ class Trainer:
         out = {"wall_time": wall, "samples": samples,
                "throughput": (samples / wall if samples and wall > 0
                               else None),
-               "step_cost": self.exe.last_step_cost, "mfu": None}
+               "step_cost": self.exe.last_step_cost, "mfu": None,
+               "grad_norm": self._read_grad_norm()}
         sc = self.exe.last_step_cost or {}
         flops = sc.get("flops")
         if flops and sc.get("steps"):
             flops = flops / sc["steps"]  # scan executable: whole-group
         out["mfu"] = _hardware.mfu(flops, wall, self._peak_flops())
         return out
+
+    def _read_grad_norm(self):
+        """The step's global grad norm from the scope's ``@GRAD_NORM@``
+        entry (the Executor emits it alongside the state; a scalar host
+        sync, already materialized by the fetch sync).  Also sets the
+        ``trainer.grad_norm`` gauge — the training-dynamics signal the
+        flight recorder's NaN window is built from."""
+        var = global_scope().find_var(GRAD_NORM_VAR)
+        if var is None:
+            return None
+        try:
+            gn = float(np.asarray(var))
+        except Exception:
+            return None
+        _obs.get_registry().gauge(
+            "trainer.grad_norm",
+            help="global gradient norm of the last step").set(gn)
+        return gn
+
+    # -- flight recorder (docs/observability.md "Flight recorder") ---------
+    def _flight_step(self, pass_id, batch_id, cost, reader_wait, tele,
+                     **phases):
+        """One step record into the bounded flight ring: loss, grad
+        norm, phase durations, HBM high-water, collective bytes and
+        lint/tune counters — the post-mortem context a crash bundle
+        ships.  A NaN step cost (incl. the PR-8 ``nan_grad`` injected
+        fault) additionally dumps the bundle, once per trainer."""
+        sc = tele.get("step_cost") or {}
+        att = sc.get("attribution") or {}
+        _flight.record_step(
+            pass_id=pass_id, batch=batch_id, step=self._global_step,
+            loss=cost, wall_time=tele.get("wall_time"),
+            reader_wait=reader_wait, grad_norm=tele.get("grad_norm"),
+            mfu=tele.get("mfu"),
+            hbm_high_water_bytes=(
+                sc.get("hbm_high_water_bytes")
+                or _obs.get_registry().value(
+                    "device.hbm_high_water_bytes") or None),
+            collective_bytes=sc.get("collective_bytes"),
+            lint_findings=sc.get("lint_findings"),
+            lint_errors=sc.get("lint_errors"),
+            tune=sc.get("tune"),
+            attr_est_ms=att.get("est_ms_total"),
+            **phases)
+        import math
+
+        if isinstance(cost, float) and math.isnan(cost) \
+                and not self._nan_dumped:
+            self._nan_dumped = True
+            _obs.get_registry().counter(
+                "trainer.nan_costs",
+                help="steps whose fetched loss was NaN").inc()
+            _flight.dump("nan_trip", loss=cost, pass_id=pass_id,
+                         batch=batch_id, step=self._global_step)
+
+    def _flight_crash(self, e):
+        """Dump the flight bundle for an exception escaping the train
+        loop — unless the nan guard already dumped for this abort (the
+        executor marks its FloatingPointError)."""
+        if getattr(e, "_pt_nan_counted", False):
+            return  # the executor's nan-trip path already dumped
+        _flight.dump(_flight.classify_exception(e),
+                     error=f"{type(e).__name__}: {e}"[:300],
+                     step=self._global_step)
 
     def _train_fused(self, reader, num_passes, event_handler, checkpoint_dir,
                      checkpoint_every_n_passes, async_checkpoint,
@@ -466,6 +551,8 @@ class Trainer:
                     metrics = [np.asarray(v) for v in row[1:]]
                     event_handler(EndIteration(pass_id, batch_id, cost,
                                                metrics, **(telemetry or {})))
+                    self._flight_step(pass_id, batch_id, cost, None,
+                                      telemetry or {})
 
                 def flush(pending, batch_id):
                     nonlocal group_n, auto
@@ -581,6 +668,9 @@ class Trainer:
                 self._pass_checkpoint(pass_id, ckpt, checkpoint_dir,
                                       checkpoint_every_n_passes)
                 event_handler(EndPass(pass_id))
+        except Exception as e:
+            self._flight_crash(e)  # same post-mortem as the unfused loop
+            raise
         finally:
             if wd is not None:
                 wd.stop()

@@ -382,6 +382,56 @@ def test_memaudit_shims_delegate_with_deprecation():
     assert memaudit.REDUCE_COLLECTIVES == analysis.REDUCE_COLLECTIVES
 
 
+def test_memaudit_shims_warn_exactly_once():
+    """Each shim function emits EXACTLY one DeprecationWarning per
+    process, however many times it is called — the PR-6 contract.  (No
+    in-repo caller imports the shims anymore; this pins the behavior
+    for external callers.)"""
+    import warnings
+
+    from paddle_tpu.core import memaudit
+
+    text = _INLOOP_HLO
+    memaudit._warned.discard("hlo_comm_report")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        memaudit.hlo_comm_report(text)
+        memaudit.hlo_comm_report(text)
+        memaudit.hlo_comm_report(text)
+    dep = [w for w in rec if issubclass(w.category, DeprecationWarning)
+           and "memaudit" in str(w.message)]
+    assert len(dep) == 1, [str(w.message) for w in rec]
+
+
+def test_no_in_repo_memaudit_shim_callers():
+    """The deprecated ``core.memaudit`` shims have zero remaining
+    in-repo importers (ISSUE 11 satellite): everything routes through
+    ``paddle_tpu.analysis`` directly, so the shim file is the ONLY
+    place the module name appears in an import statement."""
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(
+        pt.__file__)))
+    offenders = []
+    for dirpath, _dirs, files in os.walk(root):
+        if any(part in dirpath for part in
+               ("__pycache__", ".git", "/.claude", ".venv", "venv",
+                "site-packages", "node_modules", "/build")):
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if path.endswith("core/memaudit.py") or fn == "test_analysis.py":
+                continue  # the shim itself + its contract tests
+            src = open(path, "r", encoding="utf-8",
+                       errors="ignore").read()
+            if re.search(r"^\s*(from|import)\s+[\w.]*memaudit",
+                         src, re.MULTILINE):
+                offenders.append(os.path.relpath(path, root))
+    assert not offenders, offenders
+
+
 def test_memaudit_audit_program_shim():
     from paddle_tpu.core.memaudit import audit_program
 

@@ -10,7 +10,7 @@ Pins the three coordinated pieces of the memory engine:
   ``lax.scan`` bodies — no per-layer unrolled kernel calls, no pallas
   operand with a leading layer-count axis, and the optimized HLO is
   free of the exact BENCH_r05 failure shape ``[L, t, d_model]``
-  (checked via ``core/memaudit.audit_program`` +
+  (checked via ``analysis.audit_program`` +
   ``compiled.memory_analysis()``, CPU-safe);
 - **policy="offload"**: marks selective segments plus the program
   offload flag, is loss AND grad BIT-EXACT vs ``selective`` (a pure
@@ -28,7 +28,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as pt
-from paddle_tpu.core.memaudit import audit_program
+from paddle_tpu.analysis import audit_program
 from paddle_tpu.core.program import GRAD_SUFFIX
 from paddle_tpu.models import transformer
 

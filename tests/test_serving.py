@@ -291,3 +291,66 @@ def test_background_thread_driver(params):
     finally:
         eng.stop()
     assert eng.idle
+
+
+# -- SLO budgets + goodput (ISSUE 11: goodput-under-SLO measurement) --------
+
+def test_slo_violations_counted(params):
+    """An impossibly tight TTFT budget: every completed request is a
+    violation, goodput stays zero, and each handle carries its
+    verdict."""
+    eng = _engine(params, ttft_slo_s=1e-9)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, VOCAB, (4,)) for _ in range(3)]
+    eng.generate_many(prompts, max_new_tokens=4)
+    st = eng.stats()
+    assert st["serving.slo_violations"] == 3
+    assert st["serving.goodput_tok_s"] == 0.0
+    assert all(r.slo_ok is False for r in eng.results())
+
+
+def test_goodput_counts_slo_met_tokens(params):
+    """Generous budgets: zero violations, goodput > 0, verdicts True."""
+    eng = _engine(params, ttft_slo_s=600.0, e2e_slo_s=600.0)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, VOCAB, (4,)) for _ in range(3)]
+    eng.generate_many(prompts, max_new_tokens=4)
+    st = eng.stats()
+    assert st.get("serving.slo_violations", 0) == 0
+    assert st["serving.goodput_tok_s"] > 0
+    assert all(r.slo_ok is True for r in eng.results())
+
+
+def test_no_slo_configured_leaves_verdict_none(params):
+    eng = _engine(params)
+    eng.generate_many([np.arange(1, 4, dtype=np.int32)],
+                      max_new_tokens=3)
+    st = eng.stats()
+    assert "serving.slo_violations" not in st
+    assert all(r.slo_ok is None for r in eng.results())
+
+
+def test_reset_slo_accounting_reopens_window(params):
+    """The bench warm-pass contract: resetting after warm requests
+    zeroes the violation counter and the goodput window."""
+    eng = _engine(params, ttft_slo_s=1e-9)
+    eng.generate_many([np.arange(1, 4, dtype=np.int32)],
+                      max_new_tokens=3)
+    assert eng.stats()["serving.slo_violations"] == 1
+    eng.reset_slo_accounting()
+    assert eng.stats()["serving.slo_violations"] == 0
+    assert eng.stats()["serving.goodput_tok_s"] == 0.0
+    eng.ttft_slo_s = 600.0
+    eng.e2e_slo_s = 600.0
+    eng.generate_many([np.arange(1, 4, dtype=np.int32)],
+                      max_new_tokens=3)
+    st = eng.stats()
+    assert st["serving.slo_violations"] == 0
+    assert st["serving.goodput_tok_s"] > 0
+
+
+def test_slo_budget_validation(params):
+    with pytest.raises(ValueError):
+        _engine(params, ttft_slo_s=0)
+    with pytest.raises(ValueError):
+        _engine(params, e2e_slo_s=-1.0)

@@ -106,6 +106,20 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_tune.log >&2
     exit 1
 fi
+# attribution smoke: the per-op performance attribution engine + crash
+# flight recorder — the compiled GPT flagship-family step's attribution
+# table covers >= 95% of cost-analysis flops with a tune-style workload
+# key, the roofline estimate-vs-measured error is reported, injected
+# NaN/watchdog faults each dump a loadable flight bundle containing the
+# triggering step, and a planted bench-history regression is attributed
+# to the op class whose share moved (docs/observability.md)
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --attribution-selftest \
+        > /tmp/_t1_attr.log 2>&1; then
+    echo "TIER1 REGRESSION: attribution selftest failed" >&2
+    cat /tmp/_t1_attr.log >&2
+    exit 1
+fi
 # bench-history gate: every BENCH_*/MULTICHIP_* artifact in the repo
 # must classify (failures acknowledged in tools/bench_known_failures.json
 # with a root cause, never silent) and no tracked metric may regress
@@ -150,7 +164,8 @@ rows = [json.loads(l) for l in open('/tmp/_t1_serving.json') if l.strip()]
 assert len(rows) == 1, f'expected ONE json line, got {len(rows)}'
 row = rows[0]
 for k in ('tok_s', 'baseline_tok_s', 'speedup', 'ttft_p50_ms',
-          'e2e_p99_ms', 'prefill_compiles', 'decode_compiles'):
+          'e2e_p99_ms', 'prefill_compiles', 'decode_compiles',
+          'goodput_under_slo', 'slo_violations'):
     assert k in row, f'missing field {k}: {row}'
 print('serving smoke:', json.dumps(row))
 "; then
