@@ -790,6 +790,10 @@ def serving_rows(extra, timeout=900):
                           "serving_queue_wait_p50_ms"),
                          ("goodput_under_slo",
                           "serving_goodput_under_slo"),
+                         ("fifo_goodput_under_slo",
+                          "serving_fifo_goodput_under_slo"),
+                         ("prefix_hit_rate", "serving_prefix_hit_rate"),
+                         ("shed_total", "serving_shed_total"),
                          ("slo_violations", "serving_slo_violations")):
             if isinstance(row.get(src), (int, float)):
                 extra[dst] = row[src]

@@ -72,7 +72,8 @@ _EXTRA_METRICS = (
     "gpt_t16k_tune_tok_s",
 )
 _MULTICHIP_METRICS = ("scaling_efficiency", "param_bytes_per_device")
-_SERVING_METRICS = ("tok_s", "speedup", "goodput_under_slo")
+_SERVING_METRICS = ("tok_s", "speedup", "goodput_under_slo",
+                    "prefix_hit_rate")
 
 # a per-class share has to move at least this much (absolute) before
 # the regression attribution names it — sub-2% wiggle is measurement

@@ -32,6 +32,11 @@ kind           point               effect at the n-th arrival
 ``nan_grad``   ``trainer.step``    return ``"nan"`` so the caller poisons
                                    the step's loss — drives the nan-guard
                                    / bad-step telemetry path
+``slot_death`` ``serving.decode``  return ``"slot_death"`` so the serving
+                                   engine kills one active request
+                                   mid-decode — its slot AND its paged KV
+                                   blocks must be reclaimed (no block
+                                   leak) and the driver must survive
 =============  ==================  =======================================
 
 Arrival counters are per-process module state; ``reset()`` exists for
@@ -54,6 +59,7 @@ _POINT_OF = {
     "io_error": "ckpt.write",
     "reader_err": "reader.next",
     "nan_grad": "trainer.step",
+    "slot_death": "serving.decode",
 }
 
 _counts = {}  # point -> arrivals so far (per process)
@@ -141,4 +147,8 @@ def maybe_fault(point):
         raise RuntimeError(f"injected reader exception ({ENV_VAR})")
     elif sp.kind == "nan_grad":
         return "nan"
+    elif sp.kind == "slot_death":
+        # the serving engine evicts one live request and reclaims its
+        # slot + KV blocks (engine._kill_one_slot)
+        return "slot_death"
     return None

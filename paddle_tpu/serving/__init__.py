@@ -1,15 +1,27 @@
-"""Continuous-batching serving — multi-request decode over the flagship
-transformer's KV-cache serving path (`docs/serving.md`).
+"""Continuous-batching serving — multi-request decode over a paged,
+prefix-shared KV cache with SLO-aware goodput scheduling
+(`docs/serving.md`).
 
 ``ServingEngine`` keeps one fixed-capacity batched decode step (compiled
-once) saturated across many concurrent, variable-length requests: a slot
-pool over the batched KV cache, admission between decode chunks
-(continuous batching), power-of-two shape-bucketed prefill so compile
-count is bounded by the bucket set, and full ``serving.*`` telemetry
-through the observability registry.
+once) saturated across many concurrent, variable-length requests: a
+slot pool over a PAGED block KV cache (``kvcache``: fixed-size physical
+blocks, per-slot block tables, reference-counted prefix reuse with
+copy-on-write forks and LRU cache eviction), admission between decode
+chunks (continuous batching) ordered by the SLO scheduler
+(``scheduler``: least predicted-TTFT slack, e2e-doomed requests shed),
+power-of-two shape-bucketed SUFFIX prefill so compile count is bounded
+by the bucket set, and full ``serving.*`` telemetry through the
+observability registry.
 """
 
-from . import batched_decode
+from . import batched_decode, kvcache, scheduler
 from .engine import Request, ServingEngine
+from .kvcache import BlockPool, PoolExhausted, PrefixTrie
+from .scheduler import (FifoScheduler, SheddedRequest, SloScheduler,
+                        TtftPredictor)
 
-__all__ = ["Request", "ServingEngine", "batched_decode"]
+__all__ = [
+    "Request", "ServingEngine", "batched_decode", "kvcache", "scheduler",
+    "BlockPool", "PoolExhausted", "PrefixTrie",
+    "FifoScheduler", "SheddedRequest", "SloScheduler", "TtftPredictor",
+]

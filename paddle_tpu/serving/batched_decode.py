@@ -1,40 +1,54 @@
-"""Slot-batched KV-cache decode — the pure-JAX compute under
+"""Paged slot-batched KV-cache decode — the pure-JAX compute under
 ``paddle_tpu.serving``.
 
-The batched cache holds ``max_slots`` independent sequences: tuples of
-``n_layer`` ``[S, T, h, dh]`` arrays plus per-slot scalars (``last_tok``
-[S] int32, ``pos`` [S] int32).  Slot rows never interact — every op here
-is row-wise (matmuls, layer norm, per-slot causal attention, per-row
-argmax), so slot ``s`` computes exactly what ``models/transformer.py
-generate`` computes at position ``pos[s]`` and greedy decode is
-token-identical to the single-stream path (the serving acceptance bar).
+KV lives in a physical block pool: per layer one
+``[num_blocks, block_tokens, h, dh]`` array, and each slot's logical
+sequence is a chain of block ids in a per-slot BLOCK TABLE row
+(``[max_slots, blocks_per_slot]`` int32, host-managed by
+``serving.kvcache``).  Position ``t`` of slot ``s`` lives at
+``(table[s, t // B], t % B)``.  Every compiled entry point writes and
+gathers THROUGH the table, so:
+
+* identical prompt prefixes can share physical blocks across slots
+  (prefix reuse — the table is data, not shape, so sharing costs no
+  recompile);
+* the compiled-executable count keeps the PR-2 bound — ONE decode chunk
+  plus one prefill per SUFFIX-length bucket (``used_buckets + 1``);
+* unused table entries point at physical block 0, the trash block:
+  overrun steps (a finished slot riding out the chunk, prefill bucket
+  padding) write garbage there and nowhere else.
 
 Three compiled entry points, built once per engine:
 
-* ``make_decode_chunk`` — ONE executable for the whole engine lifetime:
-  a ``lax.scan`` of ``chunk`` batched steps between host syncs, so the
-  per-call dispatch+sync cost amortizes over ``chunk`` tokens for every
-  active slot at once.
-* ``make_prefill`` — one executable PER SHAPE BUCKET (prompt padded to a
-  power-of-two length): scans the prompt through the same step math,
-  building a fresh ``[T, h, dh]`` cache row, then writes the whole row
-  into the batched cache at the target slot.  Compile count is bounded
-  by the bucket set, never the request count.
+* ``make_decode_chunk`` — a ``lax.scan`` of ``chunk`` batched steps
+  between host syncs; K/V for attention is gathered ``pool[table]`` per
+  layer inside the step (same bytes the contiguous spelling read — the
+  einsum always consumed the full ``[S, T, h, dh]`` view).
+* ``make_prefill`` — one executable per SUFFIX bucket: scans the
+  non-cached tail of the prompt (``tokens[start:start+length]`` padded
+  to the bucket) through the same single-token step math, starting at
+  runtime position ``start`` and attending the slot's cached blocks
+  through the table.  A request whose prefix is fully cached scans only
+  its last prompt token (the logits that seed decode are never cached).
+  The optional copy-on-write fork (``cow_src -> cow_dst``) is folded
+  into the SAME executable as a leading whole-block copy, so CoW adds
+  no executable (``cow_src == cow_dst == 0`` is the no-op spelling —
+  trash copied onto trash).
 
-Prefill deliberately reuses the single-token step (a scan over the
-bucket) instead of a full-sequence teacher-forced matmul: the scan is
-bit-identical to the reference decode (same per-row reduction shapes),
-which is what makes the engine's outputs provably equal to running each
-request alone.  Steps past the real prompt length process padding and
-write garbage K/V at positions >= length — harmless by construction:
-decode writes position ``pos`` BEFORE attending (mask ``<= pos``), so a
-garbage position is always overwritten before it is ever attended.
+Correctness discipline (unchanged from the contiguous engine): every op
+is row-wise per slot, each step writes position ``t`` BEFORE attending
+with mask ``<= t``, and garbage (trash-block content, bucket padding,
+CoW tail beyond the shared span) is either overwritten before it is
+ever attended or masked to exactly zero attention weight — so greedy
+decode through the paged engine is bit-identical to single-stream
+``transformer.generate``, prefix reuse on or off (the serving
+acceptance bar, ``tests/test_serving.py`` / ``tests/test_kvcache.py``).
 """
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["batched_step_logits", "make_decode_chunk", "make_prefill"]
+__all__ = ["paged_step_logits", "make_decode_chunk", "make_prefill"]
 
 
 def _ln(x, scale, bias, eps):
@@ -47,23 +61,30 @@ def _ln(x, scale, bias, eps):
     return xn * scale + bias
 
 
-def batched_step_logits(p, tok, t, cache_k, cache_v, n_layer, n_head,
-                        d_model, eps=1e-5):
-    """One decode step for S independent slots.
+def paged_step_logits(p, tok, t, pool_k, pool_v, table, n_layer, n_head,
+                      d_model, eps=1e-5):
+    """One decode step for S independent slots through the block table.
 
     tok [S] int32 current tokens, t [S] int32 per-slot positions,
-    cache_k/cache_v tuples of n_layer [S, T, h, dh].  Writes each slot's
-    K/V at its own position ``t_s`` (clamped to the cache), attends over
-    positions ``<= t_s``, and returns ``(logits [S, vocab] f32, cache_k',
-    cache_v')``.
+    pool_k/pool_v tuples of n_layer [num_blocks, B, h, dh], table
+    [S, NB] int32 block ids (logical capacity T = NB * B).  Writes each
+    slot's K/V at ``(table[s, t_s // B], t_s % B)`` (clamped — overrun
+    slots land in whatever their last table entry maps to, by
+    construction the trash block or an already-consumed position),
+    attends over the gathered chain masked ``<= t_s``, and returns
+    ``(logits [S, vocab] f32, pool_k', pool_v')``.
     """
     S = tok.shape[0]
-    T = cache_k[0].shape[1]
+    NB = table.shape[1]
+    B = pool_k[0].shape[1]
+    T = NB * B
     dh = d_model // n_head
     rows = jnp.arange(S)
-    tw = jnp.clip(t, 0, T - 1)  # overrun slots write in-bounds garbage
+    tw = jnp.clip(t, 0, T - 1)
+    blk = table[rows, tw // B]      # [S] physical write block
+    off = tw % B
     x = p["tok_emb.w"][tok] + p["pos_emb.w.w"][tw]          # [S, d]
-    ck_out, cv_out = [], []
+    pk_out, pv_out = [], []
     for i in range(n_layer):
         w = lambda nm: p[f"block{i}_{nm}"]
         h = _ln(x, w("ln1.scale"), w("ln1.bias"), eps)
@@ -73,11 +94,17 @@ def batched_step_logits(p, tok, t, cache_k, cache_v, n_layer, n_head,
         qh = q.reshape(S, n_head, dh)
         kh = k.reshape(S, n_head, dh)
         vh = v.reshape(S, n_head, dh)
-        # per-slot scatter: slot s writes at its own position t_s
-        ck = cache_k[i].at[rows, tw].set(kh)
-        cv = cache_v[i].at[rows, tw].set(vh)
-        ck_out.append(ck)
-        cv_out.append(cv)
+        # per-slot scatter through the table: slot s writes its own
+        # (block, offset); distinct live slots own distinct blocks, so
+        # the only possible collision is overrun garbage in the trash
+        # block — content nobody ever attends
+        pk = pool_k[i].at[blk, off].set(kh)
+        pv = pool_v[i].at[blk, off].set(vh)
+        pk_out.append(pk)
+        pv_out.append(pv)
+        # gather each slot's logical sequence view [S, T, h, dh]
+        ck = pk[table].reshape(S, T, n_head, dh)
+        cv = pv[table].reshape(S, T, n_head, dh)
         s = jnp.einsum("shd,sThd->shT", qh, ck,
                        preferred_element_type=jnp.float32)
         s = s / jnp.sqrt(float(dh))
@@ -93,7 +120,7 @@ def batched_step_logits(p, tok, t, cache_k, cache_v, n_layer, n_head,
     x = _ln(x, p["ln_f.scale"], p["ln_f.bias"], eps)
     logits = jnp.matmul(x, p["lm_head.w"],
                         preferred_element_type=jnp.float32)
-    return logits, tuple(ck_out), tuple(cv_out)
+    return logits, tuple(pk_out), tuple(pv_out)
 
 
 def make_decode_chunk(n_layer, n_head, d_model, chunk, eps=1e-5,
@@ -101,70 +128,80 @@ def make_decode_chunk(n_layer, n_head, d_model, chunk, eps=1e-5,
     """Build the batched decode executable: ``chunk`` greedy steps for
     every slot in one device call.
 
-    ``fn(params, cache_k, cache_v, last_tok, pos) -> (cache_k', cache_v',
-    last_tok', pos', toks [chunk, S] int32)`` — ``toks[j]`` is the token
-    each slot emitted at its ``pos+j``'th position.  The caches and slot
-    scalars are donated (updated in place on TPU); callers must replace
-    their references with the outputs.
+    ``fn(params, pool_k, pool_v, last_tok, pos, table) -> (pool_k',
+    pool_v', last_tok', pos', toks [chunk, S] int32)`` — ``toks[j]`` is
+    the token each slot emitted at its ``pos+j``'th position.  The pool
+    and slot scalars are donated (updated in place on TPU); the table is
+    a small host-fed int32 array (data, not donated).  Callers must
+    replace their references with the outputs.
     """
 
-    def decode_chunk(p, cache_k, cache_v, last_tok, pos):
+    def decode_chunk(p, pool_k, pool_v, last_tok, pos, table):
         def body(carry, _):
-            ck, cv, tok, t = carry
-            logits, ck, cv = batched_step_logits(
-                p, tok, t, ck, cv, n_layer, n_head, d_model, eps)
+            pk, pv, tok, t = carry
+            logits, pk, pv = paged_step_logits(
+                p, tok, t, pk, pv, table, n_layer, n_head, d_model, eps)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (ck, cv, nxt, t + 1), nxt
+            return (pk, pv, nxt, t + 1), nxt
 
-        (ck, cv, tok, t), toks = jax.lax.scan(
-            body, (cache_k, cache_v, last_tok, pos), None, length=chunk)
-        return ck, cv, tok, t, toks
+        (pk, pv, tok, t), toks = jax.lax.scan(
+            body, (pool_k, pool_v, last_tok, pos), None, length=chunk)
+        return pk, pv, tok, t, toks
 
     return jax.jit(decode_chunk,
                    donate_argnums=(1, 2, 3, 4) if donate else ())
 
 
-def make_prefill(n_layer, n_head, d_model, bucket, max_len, eps=1e-5,
+def make_prefill(n_layer, n_head, d_model, bucket, eps=1e-5,
                  donate=True):
-    """Build the prefill executable for one prompt-length bucket.
+    """Build the prefill executable for one SUFFIX-length bucket.
 
-    ``fn(params, cache_k, cache_v, last_tok, pos, slot, prompt [bucket],
-    length) -> (cache_k', cache_v', last_tok', pos', first_tok)`` —
-    scans the padded prompt through the step math on a fresh zero cache
-    row, writes the row into the batched cache at ``slot``, seeds the
-    slot's ``last_tok`` with the first generated token (greedy argmax at
-    the last real prompt position, ``length - 1``) and ``pos`` with
-    ``length``.  ``first_tok`` is also returned as a scalar so the
-    scheduler can report TTFT / detect an immediate EOS without pulling
-    the whole slot state back.
+    ``fn(params, pool_k, pool_v, last_tok, pos, slot, table_row [NB],
+    toks [bucket], start, length, cow_src, cow_dst) -> (pool_k',
+    pool_v', last_tok', pos', first_tok)`` — first copies block
+    ``cow_src`` onto ``cow_dst`` whole (the copy-on-write fork; the
+    no-fork spelling passes ``0, 0``, trash onto trash), then scans the
+    padded prompt SUFFIX through the step math at positions ``start +
+    i``, writing K/V through ``table_row`` and attending the slot's
+    cached chain (positions ``< start`` were shared from the prefix
+    trie and are read, never recomputed).  Seeds the slot's
+    ``last_tok`` with the first generated token (greedy argmax at the
+    last real prompt position, scan step ``length - 1``) and ``pos``
+    with ``start + length``.  ``first_tok`` is also returned as a
+    scalar so the scheduler can report TTFT / detect an immediate EOS
+    without pulling slot state back.
+
+    Steps past ``length`` process padding and write garbage at
+    positions ``>= start + length`` — harmless by construction: each
+    step writes BEFORE attending (mask ``<= t``), so the real steps
+    never see padding writes, and decode overwrites position ``pos``
+    before its first attend.
     """
-    dh = d_model // n_head
 
-    def prefill(p, cache_k, cache_v, last_tok, pos, slot, prompt, length):
-        dtype = cache_k[0].dtype
-        row_k = tuple(jnp.zeros((1, max_len, n_head, dh), dtype)
-                      for _ in range(n_layer))
-        row_v = tuple(jnp.zeros((1, max_len, n_head, dh), dtype)
-                      for _ in range(n_layer))
+    def prefill(p, pool_k, pool_v, last_tok, pos, slot, table_row,
+                toks, start, length, cow_src, cow_dst):
+        # copy-on-write fork: duplicate the whole source block; the
+        # shared tokens are the live prefix, the tail is garbage the
+        # suffix scan / decode overwrites before ever attending it
+        pool_k = tuple(c.at[cow_dst].set(c[cow_src]) for c in pool_k)
+        pool_v = tuple(c.at[cow_dst].set(c[cow_src]) for c in pool_v)
 
-        def body(carry, t):
-            ck, cv = carry
-            tok = jax.lax.dynamic_slice_in_dim(prompt, t, 1)  # [1]
-            logits, ck, cv = batched_step_logits(
-                p, tok, t[None], ck, cv, n_layer, n_head, d_model, eps)
+        def body(carry, i):
+            pk, pv = carry
+            tok = jax.lax.dynamic_slice_in_dim(toks, i, 1)  # [1]
+            t = (start + i)[None]
+            logits, pk, pv = paged_step_logits(
+                p, tok, t, pk, pv, table_row[None], n_layer, n_head,
+                d_model, eps)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (ck, cv), nxt[0]
+            return (pk, pv), nxt[0]
 
-        (row_k, row_v), nxts = jax.lax.scan(
-            body, (row_k, row_v), jnp.arange(bucket))
+        (pool_k, pool_v), nxts = jax.lax.scan(
+            body, (pool_k, pool_v), jnp.arange(bucket))
         first = jax.lax.dynamic_index_in_dim(nxts, length - 1,
                                              keepdims=False)
-        cache_k = tuple(c.at[slot].set(r[0])
-                        for c, r in zip(cache_k, row_k))
-        cache_v = tuple(c.at[slot].set(r[0])
-                        for c, r in zip(cache_v, row_v))
         last_tok = last_tok.at[slot].set(first)
-        pos = pos.at[slot].set(length)
-        return cache_k, cache_v, last_tok, pos, first
+        pos = pos.at[slot].set(start + length)
+        return pool_k, pool_v, last_tok, pos, first
 
     return jax.jit(prefill, donate_argnums=(1, 2, 3, 4) if donate else ())

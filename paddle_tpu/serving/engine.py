@@ -1,5 +1,6 @@
 """Continuous-batching serving engine — slot-scheduled multi-request
-decode over the flagship transformer's KV-cache serving path.
+decode over a PAGED, prefix-shared KV cache with SLO-aware goodput
+scheduling.
 
 ``models/transformer.py generate`` turned decode into a single jitted
 scan, but it serves exactly one request per call: chip utilization
@@ -9,36 +10,45 @@ requests).  Decode is HBM-bandwidth-bound on WEIGHT reads, so batching
 — nearly free throughput.  The engine keeps one fixed-capacity batched
 decode step saturated across many requests:
 
-* **Slot pool** — the batched KV cache has ``max_slots`` rows; each row
-  holds one active sequence with its own length (``pos``).  A slot is
-  freed the moment its request hits EOS or its token budget, and the
-  row is fully overwritten by the next prefill (stale K/V is never
-  attended: decode writes position ``pos`` before masking ``<= pos``).
+* **Paged slot pool** — KV lives in a physical block pool
+  (``serving.kvcache``): fixed-size blocks of ``block_tokens``
+  positions, indexed per slot by a block table the compiled step
+  gathers through.  A slot is a chain of blocks, not a contiguous row;
+  blocks are reference-counted and returned to the pool the moment
+  nothing uses them.
+* **Prefix reuse** — identical prompt prefixes (system prompts,
+  few-shot templates — the dominant production traffic shape) map
+  through a trie to SHARED block chains: a request whose prefix is
+  cached skips that portion of prefill entirely (full blocks shared by
+  refcount; a divergence inside a cached block forks it copy-on-write).
+  ``serving.prefix_hit_rate`` / ``serving.cow_copies`` /
+  ``serving.blocks_in_use`` expose it live.
 * **Continuous batching** — queued requests are admitted into free
   slots BETWEEN decode chunks, not at batch boundaries: a long request
   never holds the batch hostage, a short one never waits for stragglers.
-* **Bucketed prefill** — prompts pad to the nearest power-of-two bucket
-  so the compile cache is bounded by the bucket set (TVM-style static
-  shape buckets), never by the request count: total executables =
-  ``len(used prefill buckets) + 1`` decode chunk.
+* **Bucketed prefill** — the NON-CACHED prompt suffix pads to the
+  nearest power-of-two bucket, so the compile cache is bounded by the
+  bucket set (TVM-style static shape buckets), never by the request
+  count: total executables = ``len(used prefill buckets) + 1`` decode
+  chunk — the copy-on-write fork rides inside the prefill executable.
 * **Chunked decode** — ``decode_chunk`` steps run per device call
-  (one ``lax.scan``), amortizing dispatch + host sync over
-  ``chunk × active_slots`` tokens.  EOS is detected on the host after
-  the chunk; a slot finishing mid-chunk wastes at most ``chunk - 1``
-  garbage steps (discarded, never surfaced).
+  (one ``lax.scan``), amortizing dispatch + host sync.  EOS is detected
+  on the host after the chunk.
+* **SLO-aware scheduling** — the CONTROL half of the goodput loop
+  (``serving.scheduler``; PR 11 shipped the measurement half): the
+  queue is admitted by least predicted-TTFT slack and requests that
+  provably cannot meet their e2e budget are SHED immediately
+  (``serving.shed_total``) instead of burning decode capacity on
+  tokens nobody receives on time.  ``scheduler="fifo"`` keeps the PR-2
+  policy as the benchmark baseline.
 
 Greedy decode through the engine is token-identical to running each
-request alone through ``transformer.generate`` (same per-row math; see
-``batched_decode``).  Telemetry flows through the global observability
-registry under ``serving.*`` (queue depth, slot occupancy, admitted /
-completed / token counters, TTFT + per-step + e2e histograms, tok/s
-gauge, compile counters) — plus the TTFT decomposition pair
-``serving.queue_wait`` (submit -> admission pop) and
-``serving.decode_chunk`` (per chunk call), the measurement SLO-aware
-admission needs.  With tracing enabled (``observability.trace``,
-default on) every finished request also lays a span tree on its own
-timeline lane — submit -> queue -> prefill(bucket) -> per-decode-chunk
--> evict — exported to Chrome-trace via ``trace.save(path)``.
+request alone through ``transformer.generate`` — prefix reuse on or off
+(same per-row math; see ``batched_decode``).  Telemetry flows through
+the global observability registry under ``serving.*``; with tracing
+enabled every finished request lays a span tree on its own timeline
+lane (submit -> queue -> prefill(bucket, prefix_hit) -> per-decode-
+chunk -> evict) exported to Chrome-trace via ``trace.save(path)``.
 """
 
 import collections
@@ -50,7 +60,10 @@ import numpy as np
 from ..observability import flight as _flight
 from ..observability import metrics as _obs
 from ..observability import trace as _trace
+from ..resilience import faults as _faults
 from . import batched_decode as _bd
+from . import kvcache as _kv
+from . import scheduler as _sched
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -63,15 +76,19 @@ class Request:
     are thread-safe: ``wait``/``result`` may be called from any thread
     while the engine runs in another.  If the engine aborts (a device
     error mid-serve), the handle completes with ``error`` set and
-    ``result()`` re-raises it instead of hanging waiters forever.
+    ``result()`` re-raises it instead of hanging waiters forever; a
+    request the SLO scheduler sheds completes with ``shed`` True and a
+    ``SheddedRequest`` error.
     """
 
     __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens",
                  "submit_t", "first_token_t", "finish_t", "error",
                  "admit_t", "prefill_t0", "prefill_t1", "bucket",
-                 "chunks", "slo_ok", "_done")
+                 "chunks", "slo_ok", "ttft_slo_s", "e2e_slo_s",
+                 "shed", "sheddable", "prefix_hit", "_done")
 
-    def __init__(self, rid, prompt, max_new, eos_id):
+    def __init__(self, rid, prompt, max_new, eos_id,
+                 ttft_slo_s=None, e2e_slo_s=None, sheddable=True):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
@@ -90,9 +107,20 @@ class Request:
         self.prefill_t1 = None
         self.bucket = None
         self.chunks = []
-        # SLO verdict at finish: True (met), False (violated), or None
-        # (the engine has no SLO budgets configured)
+        # SLO verdict at finish: True (met), False (violated/shed), or
+        # None (no SLO budgets configured); per-request budgets override
+        # the engine-level defaults
         self.slo_ok = None
+        self.ttft_slo_s = ttft_slo_s
+        self.e2e_slo_s = e2e_slo_s
+        self.shed = False
+        # False exempts the request from scheduler shedding (it is
+        # still judged against its budgets at finish) — the synchronous
+        # generate_many front-end uses this: its caller waits for every
+        # result, so refusing one only destroys output
+        self.sheddable = sheddable
+        # prompt tokens whose prefill was skipped via the prefix trie
+        self.prefix_hit = 0
         self._done = threading.Event()
 
     @property
@@ -106,8 +134,14 @@ class Request:
         if not self._done.wait(timeout):
             raise TimeoutError(f"request {self.rid} not finished")
         if self.error is not None:
+            if isinstance(self.error, _sched.SheddedRequest):
+                raise self.error
+            # the cause names what actually happened — an engine abort,
+            # an injected slot death (engine still serving), a driver
+            # death — don't claim more than "this request failed"
             raise RuntimeError(
-                f"request {self.rid} failed: engine aborted") \
+                f"request {self.rid} failed: "
+                f"{type(self.error).__name__}: {self.error}") \
                 from self.error
         return np.concatenate(
             [self.prompt, np.asarray(self.tokens, np.int32)])
@@ -128,28 +162,45 @@ class Request:
 
 
 class ServingEngine:
-    """Slot-scheduled continuous-batching front-end over the batched
-    decode kernels.
+    """Slot-scheduled continuous-batching front-end over the paged
+    batched decode kernels.
 
     params   name->array dict with the Program's parameter names (e.g.
              ``transformer.extract_params()``); cast once to
              ``compute_dtype`` (default: the dtype the block/lm_head
              matmul weights imply — bf16-trained weights serve in bf16).
-    max_len  per-slot KV-cache capacity; every request needs
+    max_len  per-slot logical KV capacity; every request needs
              ``len(prompt) + max_new_tokens <= max_len``.
     max_slots     concurrent sequences in the batched step.
-    decode_chunk  decode steps fused per device call.
-    min_bucket    smallest prefill bucket; prompts pad to the nearest
-             power-of-two multiple of it (compile-count bound).
+    decode_chunk  decode steps fused per device call.  ``None`` (the
+             default) consults the autotune cache (workload key
+             ``op=serving_decode``, docs/autotune.md) and falls back
+             to 4 on a miss; an explicit value always wins.
+    min_bucket    smallest prefill bucket; prompt SUFFIXES (after prefix
+             reuse) pad to the nearest power-of-two multiple of it
+             (compile-count bound).  ``None`` consults the same tuned
+             entry; miss falls back to 8.
+    block_tokens  tokens per physical KV block (paging granularity —
+             also the prefix-sharing granularity: only whole blocks are
+             shared, a partial overlap forks copy-on-write).
+    cache_blocks  prefix-cache capacity budget: blocks the trie may
+             keep alive beyond live requests (LRU-evicted under
+             pressure).  Default ``2 * ceil(max_len / block_tokens)``.
+    prefix_reuse  False disables the trie (every request pays full
+             prefill — the PR-2 spelling; bit-exactness is gated in
+             BOTH modes).
+    scheduler  "slo" (default: least-TTFT-slack admission + e2e-doomed
+             shedding; with no budgets configured it degrades to FIFO
+             order) or "fifo" (the PR-2 baseline policy).
     eos_id   default EOS token id (per-request override in ``submit``).
-    ttft_slo_s / e2e_slo_s   per-request latency budgets (seconds).
-             When set, every finished request is judged at finish time
+    ttft_slo_s / e2e_slo_s   per-request latency budgets (seconds),
+             overridable per request in ``submit``.  When set, every
+             finished request is judged at finish time
              (``Request.slo_ok``): a breach counts
              ``serving.slo_violations`` and its tokens are EXCLUDED
-             from the ``serving.goodput_tok_s`` gauge — throughput the
-             users actually experienced within budget, the
-             goodput-under-SLO measurement ROADMAP item 1(c) schedules
-             against (tok/s alone rewards serving nobody on time).
+             from the ``serving.goodput_tok_s`` gauge — and the SLO
+             scheduler admits/sheds against the same budgets, so
+             goodput (not raw tok/s) is what the engine maximizes.
 
     Drive it synchronously (``generate_many`` / ``step`` +
     ``results``) or from a background thread (``start``/``stop``) with
@@ -157,9 +208,11 @@ class ServingEngine:
     """
 
     def __init__(self, params, n_layer, n_head, d_model, max_len=128,
-                 max_slots=8, decode_chunk=4, min_bucket=8, eos_id=None,
-                 compute_dtype=None, eps=1e-5, donate=True,
-                 registry=None, ttft_slo_s=None, e2e_slo_s=None):
+                 max_slots=8, decode_chunk=None, min_bucket=None,
+                 eos_id=None, compute_dtype=None, eps=1e-5, donate=True,
+                 registry=None, ttft_slo_s=None, e2e_slo_s=None,
+                 block_tokens=16, cache_blocks=None, prefix_reuse=True,
+                 scheduler="slo"):
         import jax
         import jax.numpy as jnp
 
@@ -167,13 +220,12 @@ class ServingEngine:
 
         if d_model % n_head:
             raise ValueError(f"d_model {d_model} % n_head {n_head} != 0")
-        if max_slots < 1 or decode_chunk < 1 or min_bucket < 1:
-            raise ValueError("max_slots, decode_chunk and min_bucket "
-                             "must all be >= 1")
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1: {max_slots}")
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1: {block_tokens}")
         self.n_layer, self.n_head, self.d_model = n_layer, n_head, d_model
         self.max_len, self.max_slots = int(max_len), int(max_slots)
-        self.decode_chunk = int(decode_chunk)
-        self.min_bucket = int(min_bucket)
         self.eos_id = eos_id
         self._eps = eps
         self._donate = donate
@@ -188,6 +240,18 @@ class ServingEngine:
         if compute_dtype is None:
             compute_dtype = infer_compute_dtype(params)
         self.compute_dtype = jnp.dtype(compute_dtype)
+        # decode chunk / bucket geometry: explicit args win; defaults
+        # consult the tuned op=serving_decode entry (docs/autotune.md)
+        if decode_chunk is None or min_bucket is None:
+            cfg = self._tuned_geometry()
+            if decode_chunk is None:
+                decode_chunk = int(cfg.get("chunk", 4))
+            if min_bucket is None:
+                min_bucket = int(cfg.get("min_bucket", 8))
+        if decode_chunk < 1 or min_bucket < 1:
+            raise ValueError("decode_chunk and min_bucket must be >= 1")
+        self.decode_chunk = int(decode_chunk)
+        self.min_bucket = int(min_bucket)
         table_len = np.asarray(params["pos_emb.w.w"]).shape[0]
         if self.max_len > table_len:
             raise ValueError(
@@ -196,15 +260,37 @@ class ServingEngine:
         self._p = jax.device_put(
             {k: jnp.asarray(v, self.compute_dtype)
              for k, v in params.items()})
+
+        # -- paged KV state (kvcache.py): pool arrays + host accounting
+        self.block_tokens = int(block_tokens)
+        self.blocks_per_slot = -(-self.max_len // self.block_tokens)
+        if cache_blocks is None:
+            cache_blocks = 2 * self.blocks_per_slot if prefix_reuse else 0
+        if cache_blocks < 0:
+            raise ValueError(f"cache_blocks must be >= 0: {cache_blocks}")
+        self.cache_blocks = int(cache_blocks)
+        # trash block + every slot's worst-case chain + the cache
+        # budget: admission can ALWAYS allocate a full chain once the
+        # trie evicts its unreferenced tail (kvcache.py invariants)
+        num_blocks = (1 + self.max_slots * self.blocks_per_slot
+                      + self.cache_blocks)
+        self.kv_pool = _kv.BlockPool(num_blocks, self.block_tokens)
+        self.prefix_trie = (_kv.PrefixTrie(self.kv_pool, self.cache_blocks)
+                            if prefix_reuse else None)
+        self.prefix_reuse = bool(prefix_reuse)
         dh = d_model // n_head
-        self._ck = tuple(
-            jnp.zeros((self.max_slots, self.max_len, n_head, dh),
+        self._pk = tuple(
+            jnp.zeros((num_blocks, self.block_tokens, n_head, dh),
                       self.compute_dtype) for _ in range(n_layer))
-        self._cv = tuple(
-            jnp.zeros((self.max_slots, self.max_len, n_head, dh),
+        self._pv = tuple(
+            jnp.zeros((num_blocks, self.block_tokens, n_head, dh),
                       self.compute_dtype) for _ in range(n_layer))
         self._last = jnp.zeros((self.max_slots,), jnp.int32)
         self._pos = jnp.zeros((self.max_slots,), jnp.int32)
+        # host-side block table: unused entries -> trash block 0
+        self._table = np.zeros((self.max_slots, self.blocks_per_slot),
+                               np.int32)
+        self._slot_blocks = [None] * self.max_slots  # bids a slot holds
 
         self._slots = [None] * self.max_slots     # Request or None
         self._free = list(range(self.max_slots))  # LIFO free list
@@ -213,7 +299,7 @@ class ServingEngine:
         self._qlock = threading.Lock()    # queue/completed/counters
         self._dlock = threading.RLock()   # the device state (one driver)
         self._next_rid = 0
-        self._prefill_fns = {}            # bucket -> compiled callable
+        self._prefill_fns = {}            # suffix bucket -> compiled fn
         self._decode_fn = None
         self._thread = None
         self._stop = threading.Event()
@@ -221,11 +307,36 @@ class ServingEngine:
         self._inflight = 0                # popped from queue, not yet
                                           # slotted (visible to idle)
         self._req_lane_ends = []          # trace lane i -> last finish_t
+        # the SLO control loop: measured-latency predictor + scheduler
+        self.predictor = _sched.TtftPredictor()
+        self._sched = _sched.make_scheduler(scheduler, self.predictor,
+                                            budgets=self)
+        # prefix-hit accounting window (reset with the goodput window)
+        self._hit_tokens = 0
+        self._prompt_tokens = 0
 
         self._reg = registry or _obs.get_registry()
         self._reg.gauge("serving.slots_total").set(self.max_slots)
         self._reg.gauge("serving.slots_active").set(0)
         self._reg.gauge("serving.queue_depth").set(0)
+        self._reg.gauge(
+            "serving.kv_blocks_total",
+            help="physical KV blocks in the paged pool (excl. trash)",
+        ).set(num_blocks - 1)
+        self._reg.gauge("serving.blocks_in_use").set(0)
+
+    def _tuned_geometry(self):
+        """The tuned ``op=serving_decode`` config for this engine's
+        shape, or {} (defaults apply).  Never raises — serving must
+        construct even when the tune package is unhappy."""
+        try:
+            from .. import tune
+
+            return tune.serving_decode_config(
+                self.max_len, self.d_model // self.n_head, self.n_head,
+                self.compute_dtype) or {}
+        except Exception:  # noqa: BLE001 — lookup is best-effort
+            return {}
 
     @property
     def _tracer(self):
@@ -235,9 +346,14 @@ class ServingEngine:
         return _trace.get_tracer()
 
     # -- request intake ---------------------------------------------------
-    def submit(self, prompt, max_new_tokens=16, eos_id=None):
+    def submit(self, prompt, max_new_tokens=16, eos_id=None,
+               ttft_slo_s=None, e2e_slo_s=None, sheddable=True):
         """Queue one request; returns its ``Request`` handle.  Thread-safe
-        (producers may submit while the engine decodes)."""
+        (producers may submit while the engine decodes).  Per-request
+        ``ttft_slo_s``/``e2e_slo_s`` budgets override the engine
+        defaults for both the SLO verdict and the scheduler;
+        ``sheddable=False`` exempts the request from scheduler shedding
+        (it is still judged at finish)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p_len = prompt.shape[0]
         if p_len < 1:
@@ -266,8 +382,10 @@ class ServingEngine:
                     "serving engine aborted") from self._error
             rid = self._next_rid
             self._next_rid += 1
-            req = Request(rid, prompt,  max_new,
-                          self.eos_id if eos_id is None else eos_id)
+            req = Request(rid, prompt, max_new,
+                          self.eos_id if eos_id is None else eos_id,
+                          ttft_slo_s=ttft_slo_s, e2e_slo_s=e2e_slo_s,
+                          sheddable=sheddable)
             if self._first_submit_t is None:
                 self._first_submit_t = req.submit_t
             self._queue.append(req)
@@ -275,8 +393,8 @@ class ServingEngine:
         return req
 
     def results(self, block=False, timeout=None):
-        """Drain finished requests (FIFO completion order; aborted
-        requests surface here too, with ``error`` set).  With
+        """Drain finished requests (FIFO completion order; aborted and
+        shed requests surface here too, with ``error`` set).  With
         ``block=True``, waits up to ``timeout`` seconds for at least one
         (``timeout=0`` = poll once; ``None`` = wait indefinitely)."""
         deadline = (None if timeout is None
@@ -304,10 +422,12 @@ class ServingEngine:
 
     def step(self):
         """One scheduler iteration: admit queued requests into free slots
-        (bucketed prefill), then run one batched decode chunk.  Returns
-        the number of requests finished this iteration.
+        (scheduler-ordered, bucketed suffix prefill), then run one
+        batched decode chunk.  Returns the number of requests finished
+        this iteration (shed requests count — they completed, with
+        ``error`` set).
 
-        A device error mid-step leaves the donated caches unusable, so
+        A device error mid-step leaves the donated pool unusable, so
         it is fatal: the engine aborts — every queued and in-flight
         request completes with ``error`` set (waiters wake instead of
         hanging) and further ``submit``/``step`` calls raise."""
@@ -334,6 +454,10 @@ class ServingEngine:
                 if req is not None:
                     pending.append(req)
                     self._slots[s] = None
+                for b in self._slot_blocks[s] or ():
+                    self.kv_pool.deref(b)
+                self._slot_blocks[s] = None
+            self._table[:] = 0
             self._free = list(range(self.max_slots))
             for req in pending:
                 req.error = exc
@@ -369,7 +493,10 @@ class ServingEngine:
             raise ValueError(
                 f"max_new_tokens has {len(max_new_tokens)} entries for "
                 f"{len(prompts)} prompts")
-        reqs = [self.submit(p, m, eos_id)
+        # unsheddable: this caller waits for EVERY result, so a
+        # deadline shed could only destroy the batch's other outputs —
+        # budgets still judge each request at finish (slo_ok)
+        reqs = [self.submit(p, m, eos_id, sheddable=False)
                 for p, m in zip(prompts, max_new_tokens)]
         self.run_until_idle()
         # drain OWN handles from the completion queue (a concurrent
@@ -488,9 +615,9 @@ class ServingEngine:
         return call
 
     def bucket_for(self, p_len):
-        """Prefill bucket for a prompt length: the smallest power-of-two
-        multiple of ``min_bucket`` that covers it, capped at
-        ``max_len``."""
+        """Prefill bucket for a (suffix) length: the smallest
+        power-of-two multiple of ``min_bucket`` that covers it, capped
+        at ``max_len``."""
         b = self.min_bucket
         while b < p_len:
             b *= 2
@@ -501,7 +628,7 @@ class ServingEngine:
         if fn is None:
             fn = self._aot_with_mem_telemetry(
                 _bd.make_prefill(self.n_layer, self.n_head, self.d_model,
-                                 bucket, self.max_len, eps=self._eps,
+                                 bucket, eps=self._eps,
                                  donate=self._donate),
                 label=f"prefill_{bucket}")
             self._prefill_fns[bucket] = fn
@@ -510,6 +637,25 @@ class ServingEngine:
                 help="prefill executables built (one per shape bucket)",
             ).inc()
         return fn
+
+    def _release_slot(self, slot):
+        """Return a slot and every KV block it references to the pool
+        (shared blocks just drop one ref; private ones free).  The
+        single reclamation path — eviction, immediate-EOS, slot death
+        and abort all route here or mirror it exactly, so the fault
+        test's no-leak invariant has one owner."""
+        for b in self._slot_blocks[slot] or ():
+            self.kv_pool.deref(b)
+        self._slot_blocks[slot] = None
+        self._table[slot] = 0
+        self._slots[slot] = None
+        self._free.append(slot)
+        if self.prefix_trie is not None:
+            # blocks this slot shared with the trie are now trie-only:
+            # re-apply the cache capacity budget
+            self.prefix_trie.enforce_budget()
+        self._reg.gauge("serving.blocks_in_use").set(
+            self.kv_pool.blocks_in_use)
 
     def _decode(self):
         if self._decode_fn is None:
@@ -522,9 +668,19 @@ class ServingEngine:
                 "serving.decode_compiles",
                 help="decode-chunk executables built (one per engine)",
             ).inc()
+        import jax.numpy as jnp
+
+        # fault injection point (PADDLE_TPU_FAULT=slot_death:n): the
+        # n-th decode chunk kills one active request mid-decode — its
+        # slot and KV blocks must be reclaimed and the driver survive
+        if _faults.maybe_fault("serving.decode") == "slot_death":
+            self._kill_one_slot()
+            if not self.active_slots:
+                return 0
         t0 = time.perf_counter()
-        self._ck, self._cv, self._last, self._pos, toks = self._decode_fn(
-            self._p, self._ck, self._cv, self._last, self._pos)
+        (self._pk, self._pv, self._last, self._pos,
+         toks) = self._decode_fn(self._p, self._pk, self._pv, self._last,
+                                 self._pos, jnp.asarray(self._table))
         toks = np.asarray(toks)  # host sync: [chunk, S]
         t1 = time.perf_counter()
         wall = t1 - t0
@@ -534,6 +690,7 @@ class ServingEngine:
         # driver-thread timeline span; every live request also records
         # this window for its own lane (emitted at finish)
         self._reg.histogram("serving.decode_chunk").observe(wall)
+        self.predictor.observe_chunk(wall, self.decode_chunk)
         tracer = self._tracer
         tracer.add_span("serving.decode_chunk", t0, t1,
                         cat="serving", steps=self.decode_chunk,
@@ -557,8 +714,7 @@ class ServingEngine:
                 emitted += 1
                 if ((req.eos_id is not None and tok == req.eos_id)
                         or len(req.tokens) >= req.max_new):
-                    self._slots[s] = None
-                    self._free.append(s)
+                    self._release_slot(s)
                     self._finish(req, now)
                     finished += 1
         self._reg.counter("serving.tokens").inc(emitted)
@@ -567,75 +723,242 @@ class ServingEngine:
         self._reg.gauge("serving.slots_active").set(self.active_slots)
         return finished
 
+    def _kill_one_slot(self):
+        """Injected mid-decode slot death: fail the first active
+        request, reclaim its slot and KV blocks (the no-leak
+        regression), keep the driver alive."""
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            req.error = RuntimeError(
+                f"injected slot death (PADDLE_TPU_FAULT) — request "
+                f"{req.rid} died in slot {s} mid-decode")
+            req.finish_t = time.perf_counter()
+            self._release_slot(s)
+            self._reg.counter(
+                "serving.slot_deaths",
+                help="requests killed by injected mid-decode slot "
+                     "death (blocks + slot reclaimed)").inc()
+            self._reg.gauge("serving.slots_active").set(self.active_slots)
+            with self._qlock:
+                self._completed.append(req)
+            req._done.set()
+            return True
+        return False
+
+    def _sched_bucket(self, req):
+        """The scheduler's prefill-bucket estimate for a queued request
+        — REUSE-AWARE via a non-mutating trie probe (``peek_hit``
+        touches no LRU clock), so a mostly-cached long prompt is costed
+        at its real suffix bucket and never shed on the strength of a
+        full prefill it would not pay.  The probe can only overestimate
+        the eventual hit if the chain is evicted before admission —
+        which under-sheds, the safe direction for the optimistic-bound
+        contract."""
+        p_len = req.prompt.shape[0]
+        hit = 0
+        if self.prefix_trie is not None:
+            hit = self.prefix_trie.peek_hit(req.prompt, p_len - 1)
+        return self.bucket_for(p_len - hit)
+
     def _admit(self):
         """Move queued requests into free slots (continuous batching:
-        runs between decode chunks).  Returns requests finished AT
-        prefill (immediate EOS / max_new == 1)."""
-        import jax.numpy as jnp
-
+        runs between decode chunks), in SCHEDULER order — the SLO
+        scheduler pops by least TTFT slack and sheds e2e-doomed
+        requests.  Returns requests finished AT admission (immediate
+        EOS / max_new == 1 / shed)."""
         finished = 0
         while self._free:
+            now = time.perf_counter()
             with self._qlock:
                 if not self._queue:
                     break
-                req = self._queue.popleft()
-                # in-flight until slotted/finished, so idle never reads
-                # True while an admission is mid-prefill
-                self._inflight += 1
+                req, shed = self._sched.pick(self._queue, now,
+                                             self._sched_bucket)
+                if req is not None:
+                    # in-flight until slotted/finished, so idle never
+                    # reads True while an admission is mid-prefill
+                    self._inflight += 1
                 self._reg.gauge("serving.queue_depth").set(
                     len(self._queue))
+            for victim in shed:
+                self._shed(victim)
+                finished += 1
+            if req is None:
+                if shed:
+                    continue  # more queue may be schedulable next pass
+                break
             # queue-wait: submit -> popped for admission.  With the
             # prefill window below this decomposes TTFT into queue time
-            # vs prefill compute — the measurement SLO-aware admission
-            # (ROADMAP item 3) schedules against.
+            # vs prefill compute — the measurement the SLO-aware
+            # admission schedules against.  Observed AFTER the
+            # admission sticks: a PoolExhausted re-queue clears
+            # admit_t, so a victim's wait is counted once, at its
+            # final (successful) admission.
             req.admit_t = time.perf_counter()
-            self._reg.histogram("serving.queue_wait").observe(
-                req.admit_t - req.submit_t)
+            slot = self._free.pop()
             try:
-                slot = self._free.pop()
-                p_len = req.prompt.shape[0]
-                bucket = self.bucket_for(p_len)
-                req.bucket = bucket
-                fn = self._prefill_fn(bucket)
-                padded = np.zeros(bucket, np.int32)
-                padded[:p_len] = req.prompt
-                t_p0 = time.perf_counter()
-                (self._ck, self._cv, self._last, self._pos,
-                 first) = fn(self._p, self._ck, self._cv, self._last,
-                             self._pos, np.int32(slot),
-                             jnp.asarray(padded), np.int32(p_len))
-                first = int(np.asarray(first))  # host sync
-                now = time.perf_counter()
-                req.prefill_t0, req.prefill_t1 = t_p0, now
-                self._reg.histogram("serving.prefill_seconds").observe(
-                    now - t_p0)
-                self._tracer.add_span("serving.prefill", t_p0, now,
-                                      cat="serving", rid=req.rid,
-                                      bucket=bucket, slot=slot)
-                req.first_token_t = now
-                req.tokens.append(first)
-                self._reg.counter("serving.admitted").inc()
-                self._reg.counter("serving.tokens").inc()
-                self._reg.histogram("serving.ttft_seconds").observe(
-                    now - req.submit_t)
-                if ((req.eos_id is not None and first == req.eos_id)
-                        or req.max_new == 1):
-                    self._free.append(slot)
-                    self._finish(req, now)
-                    finished += 1
-                else:
-                    self._slots[slot] = req
+                finished += self._prefill_into(slot, req)
+                self._reg.histogram("serving.queue_wait").observe(
+                    req.admit_t - req.submit_t)
                 with self._qlock:
                     self._inflight -= 1
+            except _kv.PoolExhausted:
+                # every evictable cached chain is already gone and the
+                # live slots hold the rest: back off until decode frees
+                # blocks (put the victim back at the FRONT — it keeps
+                # its place)
+                self._free.append(slot)
+                with self._qlock:
+                    self._queue.appendleft(req)
+                    self._inflight -= 1
+                req.admit_t = None
+                if self.active_slots == 0:
+                    raise  # nothing will ever free blocks: fatal
+                break
             except Exception:
                 # put the victim back where _abort (called by step) can
                 # see and fail it with everything else
+                self._free.append(slot)
                 with self._qlock:
                     self._queue.appendleft(req)
                     self._inflight -= 1
                 raise
         self._reg.gauge("serving.slots_active").set(self.active_slots)
         return finished
+
+    def _prefill_into(self, slot, req):
+        """Admit one request into ``slot``: match the prefix trie,
+        reference shared blocks, allocate the private tail (LRU-evicting
+        cached chains under pressure), run the bucketed SUFFIX prefill
+        (with the copy-on-write fork folded in), then register the
+        prompt's full blocks in the trie.  Returns 1 when the request
+        finished at prefill (immediate EOS / max_new == 1), else 0."""
+        import jax.numpy as jnp
+
+        pool, trie = self.kv_pool, self.prefix_trie
+        p_len = req.prompt.shape[0]
+        n_total = -(-(p_len + req.max_new) // self.block_tokens)
+        shared, cow, hit = [], None, 0
+        if trie is not None:
+            shared, cow, hit = trie.match(req.prompt, p_len - 1)
+        # hold every matched block across the eviction/alloc window so
+        # LRU pressure can never free a chain we are about to attend
+        hold = list(shared) + ([cow[0]] if cow else [])
+        for b in hold:
+            pool.ref(b)
+        need = n_total - len(shared)
+        try:
+            if need > pool.free_blocks and trie is not None:
+                trie.evict_lru(need - pool.free_blocks)
+            priv = pool.alloc(need)
+        except _kv.PoolExhausted:
+            for b in hold:
+                pool.deref(b)
+            raise
+        row = np.zeros(self.blocks_per_slot, np.int32)
+        row[:len(shared)] = shared
+        row[len(shared):n_total] = priv
+        cow_src = cow_dst = 0
+        if cow is not None:
+            # fork the partially-matched cached block copy-on-write:
+            # the fork target is the first private block (logical block
+            # len(shared)); the copy itself rides inside the prefill
+            # executable, so CoW costs zero extra compiles
+            cow_src, cow_dst = cow[0], priv[0]
+            self._reg.counter(
+                "serving.cow_copies",
+                help="prefix-cache blocks forked copy-on-write").inc()
+        start = int(hit)
+        suffix = p_len - start
+        bucket = self.bucket_for(suffix)
+        req.bucket = bucket
+        req.prefix_hit = start
+        fn = self._prefill_fn(bucket)
+        padded = np.zeros(bucket, np.int32)
+        padded[:suffix] = req.prompt[start:]
+        t_p0 = time.perf_counter()
+        (self._pk, self._pv, self._last, self._pos,
+         first) = fn(self._p, self._pk, self._pv, self._last, self._pos,
+                     np.int32(slot), jnp.asarray(row),
+                     jnp.asarray(padded), np.int32(start),
+                     np.int32(suffix), np.int32(cow_src),
+                     np.int32(cow_dst))
+        first = int(np.asarray(first))  # host sync
+        now = time.perf_counter()
+        # the CoW source was held only for the copy window
+        if cow is not None:
+            pool.deref(cow[0])
+        self._table[slot] = row
+        self._slot_blocks[slot] = list(shared) + list(priv)
+        if trie is not None:
+            # register the prompt's FULL blocks (shared ones are
+            # already cached and skipped; our private full blocks
+            # become reusable by the next identical prefix)
+            trie.insert(req.prompt, [int(b) for b in row[:p_len
+                                                         // self.block_tokens]])
+        req.prefill_t0, req.prefill_t1 = t_p0, now
+        self._reg.histogram("serving.prefill_seconds").observe(now - t_p0)
+        self.predictor.observe_prefill(bucket, now - t_p0)
+        self._tracer.add_span("serving.prefill", t_p0, now,
+                              cat="serving", rid=req.rid,
+                              bucket=bucket, slot=slot,
+                              prefix_hit=start)
+        req.first_token_t = now
+        req.tokens.append(first)
+        self._reg.counter("serving.admitted").inc()
+        self._reg.counter("serving.tokens").inc()
+        self._reg.counter(
+            "serving.prefill_tokens",
+            help="prompt-suffix tokens actually scanned by prefill "
+                 "(bucket-padded; prefix hits subtract from this)",
+        ).inc(bucket)
+        self._reg.histogram("serving.ttft_seconds").observe(
+            now - req.submit_t)
+        with self._qlock:
+            self._hit_tokens += start
+            self._prompt_tokens += p_len
+            hit_rate = (self._hit_tokens / self._prompt_tokens
+                        if self._prompt_tokens else 0.0)
+        self._reg.counter(
+            "serving.prefix_hit_tokens",
+            help="prompt tokens served from the prefix cache "
+                 "(prefill skipped)").inc(start)
+        self._reg.gauge(
+            "serving.prefix_hit_rate",
+            help="cumulative prefix-cache hit rate over prompt tokens "
+                 "(since the last accounting reset)").set(hit_rate)
+        self._reg.gauge("serving.blocks_in_use").set(pool.blocks_in_use)
+        if ((req.eos_id is not None and first == req.eos_id)
+                or req.max_new == 1):
+            self._release_slot(slot)
+            # _release_slot re-appended the slot; the caller's _free
+            # bookkeeping is already consistent (slot was popped there)
+            self._finish(req, now)
+            return 1
+        self._slots[slot] = req
+        return 0
+
+    def _shed(self, req):
+        """Fail a request the scheduler refused (cannot meet its e2e
+        budget): it completes immediately with ``shed`` True and a
+        ``SheddedRequest`` error — capacity goes to requests that can
+        still meet their deadlines."""
+        now = time.perf_counter()
+        req.shed = True
+        req.slo_ok = False
+        req.error = _sched.SheddedRequest(
+            f"request {req.rid} shed after {now - req.submit_t:.3f}s in "
+            f"queue: predicted completion exceeds its e2e budget")
+        req.finish_t = now
+        self._reg.counter(
+            "serving.shed_total",
+            help="requests shed by the SLO scheduler (could no longer "
+                 "meet their e2e budget)").inc()
+        self._tracer.instant("serving.shed", cat="serving", rid=req.rid)
+        with self._qlock:
+            self._completed.append(req)
+        req._done.set()
 
     def _finish(self, req, now):
         req.finish_t = now
@@ -648,33 +971,43 @@ class ServingEngine:
         req._done.set()
 
     def reset_slo_accounting(self):
-        """Re-open the goodput window and zero the violation counter —
-        benchmarks call this after their warm pass so compile-time TTFT
-        breaches don't charge the timed run."""
+        """Re-open the goodput window and zero the violation/shed
+        counters and the prefix-hit window — benchmarks call this after
+        their warm pass so compile-time TTFT breaches (and warm-pass
+        trie traffic) don't charge the timed run.  The window ORIGIN is
+        re-armed too: the next ``submit`` starts a fresh
+        since-first-submit window, so a warm pass can never deflate the
+        timed run's ``serving.goodput_tok_s`` denominator."""
         with self._qlock:
             self._good_tokens = 0
             self._first_submit_t = None
-        c = self._reg.get("serving.slo_violations")
-        if c is not None:
-            c.reset()
-        g = self._reg.get("serving.goodput_tok_s")
-        if g is not None:
-            g.reset()
+            self._hit_tokens = 0
+            self._prompt_tokens = 0
+        for nm in ("serving.slo_violations", "serving.goodput_tok_s",
+                   "serving.shed_total", "serving.prefix_hit_rate",
+                   "serving.prefix_hit_tokens", "serving.prefill_tokens",
+                   "serving.cow_copies"):
+            m = self._reg.get(nm)
+            if m is not None:
+                m.reset()
 
     def _judge_slo(self, req, now):
         """SLO verdict at completion: a TTFT or e2e budget breach counts
         ``serving.slo_violations``; tokens of SLO-met requests feed the
         ``serving.goodput_tok_s`` gauge (good tokens over the window
         since the first submit — what the fleet delivered WITHIN budget,
-        not what it merely emitted)."""
-        if self.ttft_slo_s is None and self.e2e_slo_s is None:
+        not what it merely emitted).  Per-request budgets win over the
+        engine defaults."""
+        ttft_b = (req.ttft_slo_s if req.ttft_slo_s is not None
+                  else self.ttft_slo_s)
+        e2e_b = (req.e2e_slo_s if req.e2e_slo_s is not None
+                 else self.e2e_slo_s)
+        if ttft_b is None and e2e_b is None:
             return
         ok = True
-        if self.ttft_slo_s is not None and (
-                req.ttft is None or req.ttft > self.ttft_slo_s):
+        if ttft_b is not None and (req.ttft is None or req.ttft > ttft_b):
             ok = False
-        if self.e2e_slo_s is not None and (
-                req.e2e is None or req.e2e > self.e2e_slo_s):
+        if e2e_b is not None and (req.e2e is None or req.e2e > e2e_b):
             ok = False
         req.slo_ok = ok
         if not ok:
@@ -719,13 +1052,15 @@ class ServingEngine:
         tr.add_span("serving.request", req.submit_t, req.finish_t,
                     cat="serving", lane=lane, timer=False, rid=req.rid,
                     prompt_len=int(req.prompt.shape[0]),
-                    tokens=len(req.tokens))
+                    tokens=len(req.tokens),
+                    prefix_hit=req.prefix_hit)
         tr.add_span("serving.req.queue", req.submit_t, req.admit_t,
                     cat="serving", lane=lane, timer=False, rid=req.rid)
         if req.prefill_t0 is not None:
             tr.add_span("serving.req.prefill", req.prefill_t0,
                         req.prefill_t1, cat="serving", lane=lane,
-                        timer=False, rid=req.rid, bucket=req.bucket)
+                        timer=False, rid=req.rid, bucket=req.bucket,
+                        prefix_hit=req.prefix_hit)
         for c0, c1 in req.chunks:
             tr.add_span("serving.req.decode_chunk", c0, c1,
                         cat="serving", lane=lane, timer=False,

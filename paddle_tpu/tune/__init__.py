@@ -41,20 +41,21 @@ from .cache import (
     get_cache, reset_cache)
 from .space import (
     POLICY_ORDER, WorkloadKey, attention_candidates,
-    estimate_gpt_step_hbm, prune_static, schedule_candidates)
+    estimate_gpt_step_hbm, prune_static, schedule_candidates,
+    serving_candidates)
 from .search import (
     PreflightRejected, flagship_dims, flagship_static_demo,
-    tune_gpt_step)
+    tune_gpt_step, tune_serving_decode)
 
 __all__ = [
     "CACHE_SCHEMA_VERSION", "TuneCache", "cache_path",
     "geometry_fingerprint", "get_cache", "reset_cache",
     "POLICY_ORDER", "WorkloadKey", "attention_candidates",
     "estimate_gpt_step_hbm", "prune_static", "schedule_candidates",
-    "PreflightRejected", "flagship_dims", "flagship_static_demo",
-    "tune_gpt_step",
+    "serving_candidates", "PreflightRejected", "flagship_dims",
+    "flagship_static_demo", "tune_gpt_step", "tune_serving_decode",
     "tune_mode", "attention_config", "schedule_config_for",
-    "forced_attention_config", "tune_stats",
+    "serving_decode_config", "forced_attention_config", "tune_stats",
 ]
 
 
@@ -136,6 +137,19 @@ def schedule_config_for(seq_len, d_head, n_head, dtype):
     ``memory_optimize(policy="auto")`` and bench.py's flagship path."""
     return _cache_lookup("gpt_step", seq_len, d_head, n_head, dtype,
                          remat="auto")
+
+
+def serving_decode_config(max_len, d_head, n_head, dtype):
+    """Hot-path lookup for ``serving.ServingEngine``: the tuned decode
+    chunk size + prefill bucket geometry ``{"chunk", "min_bucket"}``
+    for one serving shape (workload key ``op=serving_decode``, keyed on
+    the slot KV capacity ``max_len``), or None — the engine keeps its
+    hand-picked defaults.  Explicit constructor arguments always win
+    (the engine only calls this when given no geometry)."""
+    if max_len is None or int(max_len) <= 0:
+        return None
+    return _cache_lookup("serving_decode", max_len, d_head, n_head,
+                         dtype, remat="-")
 
 
 def program_schedule_config(program):

@@ -31,9 +31,9 @@ from ..observability import trace as _trace
 from .cache import get_cache
 from .space import (
     POLICY_ORDER, WorkloadKey, estimate_gpt_step_hbm, prune_static,
-    schedule_candidates)
+    schedule_candidates, serving_candidates)
 
-__all__ = ["tune_gpt_step", "flagship_static_demo",
+__all__ = ["tune_gpt_step", "tune_serving_decode", "flagship_static_demo",
            "flagship_dims", "PreflightRejected"]
 
 
@@ -287,6 +287,101 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
               {k: config[k] for k in ("block_q", "block_k", "diag_w",
                                       "packed") if k in config},
               measured={"from": key.s})
+    cache.save()
+    tracer.instant("tune.winner", cat="tune", key=key.s, **config)
+    report.update(entry=entry, source="search")
+    return report
+
+
+def tune_serving_decode(params, n_layer, n_head, d_model, max_len,
+                        dtype=None, max_slots=4, requests=6, prompt_len=5,
+                        max_new=8, chunks=(2, 4, 8), min_buckets=(4, 8),
+                        max_measure=6, force=False, mode=None, seed=0):
+    """Search (or serve from cache) the serving engine's decode-chunk /
+    prefill-bucket geometry for one model shape — the
+    ``op=serving_decode`` tunable (docs/autotune.md "Adding a tunable
+    op").  Each candidate builds a REAL engine (scheduler/telemetry and
+    all), serves a fixed synthetic workload synchronously, and is timed
+    wall-to-wall; the winner's ``{"chunk", "min_bucket"}`` persists
+    under the workload key ``op=serving_decode|t=<max_len>|...|remat=-``
+    and ``ServingEngine`` consults it whenever the caller passes no
+    explicit geometry.  In mode "cached" (default) a miss NEVER builds
+    an engine — callers keep the hand-picked defaults."""
+    from . import tune_mode  # late: __init__ imports this module
+
+    import jax
+
+    reg = _obs.get_registry()
+    if dtype is None:
+        # key on the dtype the engine will SERVE in, or the persisted
+        # winner lands under a key the engine's lookup never hits
+        from ..models.transformer import infer_compute_dtype
+
+        dtype = str(np.dtype(infer_compute_dtype(params)))
+    key = WorkloadKey("serving_decode", max_len, d_model // n_head,
+                      n_head, dtype, jax.default_backend(), remat="-")
+    mode = mode or tune_mode()
+    report = {"key": key.s, "mode": mode, "entry": None, "source": "miss",
+              "candidates": 0, "measured": []}
+    if mode == "off":
+        report["source"] = "off"
+        return report
+    cache = get_cache()
+    hit = cache.get(key.s)
+    if hit is not None and not force:
+        reg.counter("tune.cache_hits",
+                    help="tuned-config cache lookups served").inc()
+        report.update(entry=hit, source="cache")
+        return report
+    reg.counter("tune.cache_misses",
+                help="tuned-config cache lookups missed").inc()
+    if mode != "search":
+        return report
+
+    reg.counter("tune.searches",
+                help="measured schedule searches executed").inc()
+    from ..serving import ServingEngine
+
+    cands = serving_candidates(max_len, chunks=chunks,
+                               min_buckets=min_buckets)
+    report["candidates"] = len(cands)
+    if max_measure and len(cands) > max_measure:
+        report["truncated_to"] = max_measure
+        cands = cands[:max_measure]
+    rng = np.random.default_rng(seed)
+    vocab = int(np.asarray(params["tok_emb.w"]).shape[0])
+    prompts = [rng.integers(1, vocab, (prompt_len,)).astype(np.int32)
+               for _ in range(requests)]
+    tracer = _trace.get_tracer()
+    measured = []
+    for i, cand in enumerate(cands):
+        with tracer.span("tune.search", cat="tune", key=key.s,
+                         candidate=i, **cand) as sp:
+            eng = ServingEngine(
+                params, n_layer, n_head, d_model, max_len=max_len,
+                max_slots=max_slots, decode_chunk=cand["chunk"],
+                min_bucket=cand["min_bucket"], prefix_reuse=False)
+            eng.generate_many(prompts[:1], max_new_tokens=2)  # compile
+            t0 = time.perf_counter()
+            eng.generate_many(prompts, max_new_tokens=max_new)
+            wall = time.perf_counter() - t0
+            reg.counter("tune.candidates_measured",
+                        help="schedule candidates compiled and timed").inc()
+            tok_s = requests * max_new / wall
+            rec = dict(cand, verdict="measured",
+                       median_s=round(wall, 6), tok_s=round(tok_s, 1))
+            measured.append(rec)
+            sp.set(verdict="measured", median_s=rec["median_s"])
+    report["measured"] = measured
+    if not measured:
+        report["source"] = "exhausted"
+        return report
+    win = min(measured, key=lambda m: m["median_s"])
+    config = {"chunk": win["chunk"], "min_bucket": win["min_bucket"]}
+    meas = {"median_s": win["median_s"], "tok_s": win["tok_s"],
+            "worst_median_s": max(m["median_s"] for m in measured),
+            "measured_candidates": len(measured)}
+    entry = cache.put(key.s, config, measured=meas)
     cache.save()
     tracer.instant("tune.winner", cat="tune", key=key.s, **config)
     report.update(entry=entry, source="search")

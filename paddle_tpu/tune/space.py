@@ -32,7 +32,8 @@ from ..ops.pallas_attention import (
 
 __all__ = [
     "WorkloadKey", "attention_candidates", "schedule_candidates",
-    "prune_static", "estimate_gpt_step_hbm", "POLICY_ORDER",
+    "serving_candidates", "prune_static", "estimate_gpt_step_hbm",
+    "POLICY_ORDER",
 ]
 
 # remat policies from cheapest recompute to most; "none" = no
@@ -154,6 +155,25 @@ def schedule_candidates(seq_len, d_head, n_head, block_caps=None,
                     if fs is not None:
                         c["fsdp"] = bool(fs)
                     out.append(c)
+    return out
+
+
+def serving_candidates(max_len, chunks=(2, 4, 8, 16, 32),
+                       min_buckets=(4, 8, 16)):
+    """The ``op="serving_decode"`` candidate list: the serving engine's
+    decode chunk size x smallest prefill bucket —
+    ``{"chunk", "min_bucket"}`` dicts (docs/autotune.md "Adding a
+    tunable op").  The static prune is pure arithmetic: a chunk larger
+    than the slot capacity wastes whole device calls on any request
+    (every emission past ``max_len`` is discarded), and a min bucket
+    beyond ``max_len`` cannot exist, so neither ever compiles."""
+    out = []
+    for c in chunks:
+        if not 1 <= int(c) <= max_len:
+            continue
+        for b in min_buckets:
+            if 1 <= int(b) <= max_len:
+                out.append({"chunk": int(c), "min_bucket": int(b)})
     return out
 
 

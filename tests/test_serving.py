@@ -354,3 +354,323 @@ def test_slo_budget_validation(params):
         _engine(params, ttft_slo_s=0)
     with pytest.raises(ValueError):
         _engine(params, e2e_slo_s=-1.0)
+    with pytest.raises(ValueError):  # undersized pool must not
+        _engine(params, cache_blocks=-1)  # construct-then-abort
+
+
+def test_reset_slo_accounting_rearms_window_origin(params):
+    """ISSUE 12 small fix: the goodput window ORIGIN must re-arm on
+    reset — after a warm pass plus a dead gap, the timed run's
+    ``serving.goodput_tok_s`` denominator starts at the timed run's
+    first submit, not back at the warm pass's (which would understate
+    goodput by the whole gap)."""
+    eng = _engine(params, ttft_slo_s=600.0, e2e_slo_s=600.0)
+    eng.generate_many([np.arange(1, 4, dtype=np.int32)],
+                      max_new_tokens=3)  # warm pass (opens a window)
+    time.sleep(0.3)                      # the dead gap between passes
+    eng.reset_slo_accounting()
+    assert eng._first_submit_t is None   # origin re-armed
+    t0 = time.perf_counter()
+    eng.generate_many([np.arange(1, 4, dtype=np.int32)],
+                      max_new_tokens=3)
+    timed_window = time.perf_counter() - t0
+    good = eng.stats()["serving.goodput_tok_s"]
+    # 3 good tokens over (at most) the timed window; a stale origin
+    # would divide by >= 0.3s extra and land far below this bound
+    assert good >= 3 / (timed_window + 0.15), \
+        f"goodput {good} suggests the window origin was not re-armed"
+    # the reset also zeroes the shed/prefix/CoW accounting windows
+    eng.reset_slo_accounting()
+    st = eng.stats()
+    assert st.get("serving.prefix_hit_rate", 0.0) == 0.0
+    assert st.get("serving.shed_total", 0) == 0
+    assert st.get("serving.cow_copies", 0) == 0
+
+
+# -- SLO scheduler: predictor, reorder, shed (ISSUE 12 control half) --------
+
+def test_predictor_learns_and_predicts():
+    from paddle_tpu.serving.scheduler import TtftPredictor
+
+    p = TtftPredictor()
+    assert not p.ready
+    p.observe_prefill(8, 0.10)
+    p.observe_chunk(0.05, steps=4)
+    assert p.ready
+    assert p.prefill_s(8) == pytest.approx(0.10)
+    # unseen bucket scales by token ratio off the nearest observed one
+    assert p.prefill_s(16) == pytest.approx(0.20)
+    # 9 new tokens: 1 rides prefill, 8 more need 2 chunks of 4
+    assert p.decode_s(9) == pytest.approx(0.10)
+    assert p.min_service_s(8, 9) == pytest.approx(0.20)
+
+
+def test_slo_scheduler_reorders_by_slack_and_sheds():
+    import collections
+    import types
+
+    from paddle_tpu.serving.scheduler import SloScheduler, TtftPredictor
+
+    pred = TtftPredictor()
+    pred.observe_prefill(8, 0.1)
+    pred.observe_chunk(0.1, steps=4)
+    budgets = types.SimpleNamespace(ttft_slo_s=None, e2e_slo_s=None)
+    sched = SloScheduler(pred, budgets)
+
+    def req(rid, age, ttft_b=None, e2e_b=None, max_new=8):
+        r = types.SimpleNamespace(
+            rid=rid, submit_t=-age, max_new=max_new,
+            ttft_slo_s=ttft_b, e2e_slo_s=e2e_b,
+            prompt=np.zeros(4, np.int32))
+        return r
+
+    # tight-budget request jumps the queue (least slack first)
+    q = collections.deque([req(0, age=0.0, ttft_b=10.0),
+                           req(1, age=0.0, ttft_b=0.5),
+                           req(2, age=0.0)])          # unbudgeted: last
+    pick, shed = sched.pick(q, now=0.0, bucket_of=lambda r: 8)
+    assert pick.rid == 1 and shed == []
+    assert [r.rid for r in q] == [0, 2]
+
+    # a request whose age + optimistic service already exceeds its e2e
+    # budget is shed; the rest survive
+    q = collections.deque([req(3, age=5.0, e2e_b=1.0),
+                           req(4, age=0.0, e2e_b=60.0)])
+    pick, shed = sched.pick(q, now=0.0, bucket_of=lambda r: 8)
+    assert [r.rid for r in shed] == [3]
+    assert pick.rid == 4 and not q
+
+    # a COLD predictor never sheds (optimistic-bound contract)
+    cold = SloScheduler(TtftPredictor(), budgets)
+    q = collections.deque([req(5, age=5.0, e2e_b=0.001)])
+    pick, shed = cold.pick(q, now=0.0, bucket_of=lambda r: 8)
+    assert pick.rid == 5 and shed == []
+
+
+def test_engine_sheds_doomed_requests(params):
+    """End-to-end shed: with a warmed predictor and an impossible e2e
+    budget, queued requests are refused — ``shed`` True, ``result()``
+    raises SheddedRequest, ``serving.shed_total`` counts — while the
+    admissible request is served."""
+    from paddle_tpu.serving import SheddedRequest
+
+    eng = _engine(params, max_slots=1)
+    rng = np.random.default_rng(11)
+    eng.generate_many([rng.integers(1, VOCAB, (4,))],
+                      max_new_tokens=8)   # warm the predictor
+    assert eng.predictor.ready
+    doomed = eng.submit(rng.integers(1, VOCAB, (4,)), max_new_tokens=8,
+                        e2e_slo_s=1e-6)
+    fine = eng.submit(rng.integers(1, VOCAB, (4,)), max_new_tokens=8)
+    eng.run_until_idle()
+    assert doomed.shed and doomed.slo_ok is False
+    with pytest.raises(SheddedRequest):
+        doomed.result(timeout=0)
+    np.testing.assert_array_equal(
+        fine.result(timeout=0)[:4], fine.prompt)
+    st = eng.stats()
+    assert st["serving.shed_total"] == 1
+    assert st["serving.completed"] == 2  # warm + fine (shed excluded)
+    assert eng.idle and eng.kv_pool.blocks_in_use >= 0
+
+
+def test_fifo_scheduler_is_pr2_spelling(params):
+    """scheduler="fifo" + prefix_reuse=False: arrival order, no shed,
+    no trie — the benchmark baseline — still token-identical."""
+    eng = _engine(params, scheduler="fifo", prefix_reuse=False,
+                  max_slots=2)
+    assert eng.prefix_trie is None
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(1, VOCAB, (l,)) for l in (3, 5, 4)]
+    outs = eng.generate_many(prompts, max_new_tokens=6)
+    for p, o in zip(prompts, outs):
+        ref, _ = transformer.generate(params, p[None], max_len=T,
+                                      n_layer=NL, n_head=NH, d_model=DM,
+                                      return_logits=False)
+        np.testing.assert_array_equal(o, np.asarray(ref)[0][: len(p) + 6])
+    assert eng.stats().get("serving.shed_total", 0) == 0
+
+
+def test_per_request_budgets_override_engine_defaults(params):
+    """submit(ttft_slo_s=, e2e_slo_s=) wins over the engine defaults in
+    the SLO verdict."""
+    eng = _engine(params, ttft_slo_s=600.0, e2e_slo_s=600.0)
+    rng = np.random.default_rng(13)
+    loose = eng.submit(rng.integers(1, VOCAB, (4,)), max_new_tokens=4)
+    tight = eng.submit(rng.integers(1, VOCAB, (4,)), max_new_tokens=4,
+                       ttft_slo_s=1e-9)
+    eng.run_until_idle()
+    assert loose.slo_ok is True
+    assert tight.slo_ok is False
+    assert eng.stats()["serving.slo_violations"] == 1
+
+
+def test_generate_many_is_never_shed(params):
+    """The synchronous batch front-end waits for every result, so its
+    requests are exempt from scheduler shedding — an impossible e2e
+    budget yields N complete outputs (judged as violations), never a
+    SheddedRequest destroying the batch."""
+    eng = _engine(params, e2e_slo_s=1e-6, max_slots=1)
+    rng = np.random.default_rng(15)
+    eng.generate_many([rng.integers(1, VOCAB, (4,))],
+                      max_new_tokens=4)   # warm the predictor
+    assert eng.predictor.ready
+    prompts = [rng.integers(1, VOCAB, (4,)) for _ in range(3)]
+    outs = eng.generate_many(prompts, max_new_tokens=4)
+    assert len(outs) == 3 and all(o.shape == (8,) for o in outs)
+    st = eng.stats()
+    assert st.get("serving.shed_total", 0) == 0
+    assert st["serving.slo_violations"] == 4  # warm + 3, all judged
+
+
+def test_sched_bucket_is_reuse_aware(params):
+    """The scheduler's prefill estimate probes the trie (without
+    touching LRU clocks): a mostly-cached prompt is costed at its
+    suffix bucket, so the shed bound stays optimistic — a request reuse
+    would save is never refused on full-prefill cost."""
+    eng = _engine(params, block_tokens=4)
+    rng = np.random.default_rng(16)
+    base = rng.integers(1, VOCAB, (12,)).astype(np.int32)
+    req = eng.submit(base.copy(), max_new_tokens=4)
+    assert eng._sched_bucket(req) == eng.bucket_for(12)  # cold: full
+    eng.run_until_idle()
+    req2 = eng.submit(base.copy(), max_new_tokens=4)
+    # 11 of 12 tokens cached (2 full blocks + 3-token CoW) -> suffix 1
+    assert eng._sched_bucket(req2) == eng.bucket_for(1)
+    def all_clocks(trie):
+        out, stack = {}, list(trie._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            out[id(n)] = n.last_used
+        return out
+
+    before = all_clocks(eng.prefix_trie)
+    eng.prefix_trie.peek_hit(base, 11)
+    assert all_clocks(eng.prefix_trie) == before  # LRU untouched
+    eng.run_until_idle()
+
+
+def test_pool_backpressure_requeues_and_counts_wait_once(params):
+    """PoolExhausted at admission re-queues the victim at the front and
+    retries once decode frees blocks; its serving.queue_wait is
+    observed exactly once, at the admission that sticks."""
+    eng = _engine(params, max_slots=2, block_tokens=4, cache_blocks=0,
+                  prefix_reuse=False)
+    rng = np.random.default_rng(17)
+    a = eng.submit(rng.integers(1, VOCAB, (9,)), max_new_tokens=8)
+    eng.step()                         # A admitted and decoding
+    hoard = eng.kv_pool.alloc(eng.kv_pool.free_blocks)  # starve the pool
+    b = eng.submit(rng.integers(1, VOCAB, (9,)), max_new_tokens=8)
+    eng.step()                         # B hits PoolExhausted, re-queued
+    assert not b.done and b.admit_t is None
+    with eng._qlock:
+        assert eng._queue[0] is b
+    for blk in hoard:
+        eng.kv_pool.deref(blk)
+    eng.run_until_idle()
+    assert a.error is None and b.error is None
+    assert eng.stats()["serving.queue_wait"]["count"] == 2  # once each
+    assert eng.kv_pool.blocks_in_use == 0
+
+
+# -- slot-death fault injection (ISSUE 12 satellite) ------------------------
+
+def test_slot_death_reclaims_blocks_and_driver_survives(params):
+    """PADDLE_TPU_FAULT=slot_death:n kills one active request
+    mid-decode: its KV blocks and slot are reclaimed (pool accounting
+    returns to baseline — no block leak), the victim's handle completes
+    with ``error`` set, and the background driver keeps serving the
+    rest of the load."""
+    import os
+
+    from paddle_tpu.resilience import faults
+
+    eng = _engine(params, max_slots=3, prefix_reuse=False)
+    rng = np.random.default_rng(14)
+    baseline_in_use = eng.kv_pool.blocks_in_use
+    os.environ["PADDLE_TPU_FAULT"] = "slot_death:2"
+    faults.reset()
+    eng.start()
+    try:
+        reqs = [eng.submit(rng.integers(1, VOCAB, (5,)),
+                           max_new_tokens=10) for _ in range(6)]
+        for r in reqs:
+            assert r.wait(timeout=120), "request did not finish"
+    finally:
+        eng.stop()
+        os.environ.pop("PADDLE_TPU_FAULT", None)
+        faults.reset()
+    dead = [r for r in reqs if r.error is not None]
+    ok = [r for r in reqs if r.error is None]
+    assert len(dead) == 1 and len(ok) == 5
+    # the victim's tokens stopped mid-stream; the survivors are exact
+    for r in ok:
+        ref, _ = transformer.generate(params, r.prompt[None], max_len=T,
+                                      n_layer=NL, n_head=NH, d_model=DM,
+                                      return_logits=False)
+        np.testing.assert_array_equal(
+            r.result(timeout=0),
+            np.asarray(ref)[0][: len(r.prompt) + 10])
+    # no block leak: pool accounting back to baseline, table zeroed
+    assert eng.kv_pool.blocks_in_use == baseline_in_use == 0
+    assert (eng._table == 0).all()
+    st = eng.stats()
+    assert st["serving.slot_deaths"] == 1
+    assert st["serving.completed"] == 5
+    assert eng.idle
+
+
+# -- tuned decode geometry (op=serving_decode, ISSUE 12 satellite) ----------
+
+def test_engine_consults_tuned_serving_geometry(params, tmp_path,
+                                                monkeypatch):
+    """docs/autotune.md "Adding a tunable op": a measured
+    tune_serving_decode search persists {chunk, min_bucket} under
+    op=serving_decode, and an engine constructed with NO explicit
+    geometry picks the winner up; explicit arguments still win; the
+    kill switch keeps the hand-picked defaults."""
+    from paddle_tpu import tune
+
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuned.json"))
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "search")
+    tune.reset_cache()
+    try:
+        report = tune.tune_serving_decode(
+            params, NL, NH, DM, max_len=T, max_slots=2, requests=3,
+            prompt_len=4, max_new=4, chunks=(2, 4), min_buckets=(4,),
+            max_measure=4)
+        assert report["source"] == "search"
+        win = report["entry"]["config"]
+        assert set(win) == {"chunk", "min_bucket"}
+
+        # default-geometry engine resolves the tuned winner
+        monkeypatch.setenv("PADDLE_TPU_TUNE", "cached")
+        eng = _engine(params, decode_chunk=None, min_bucket=None)
+        assert eng.decode_chunk == win["chunk"]
+        assert eng.min_bucket == win["min_bucket"]
+
+        # explicit args always win
+        eng2 = _engine(params, decode_chunk=7, min_bucket=16)
+        assert eng2.decode_chunk == 7 and eng2.min_bucket == 16
+
+        # kill switch: hand-picked defaults, no lookup at all
+        monkeypatch.setenv("PADDLE_TPU_TUNE", "off")
+        eng3 = _engine(params, decode_chunk=None, min_bucket=None)
+        assert eng3.decode_chunk == 4 and eng3.min_bucket == 8
+
+        # the search keys on the dtype the engine will SERVE in: bf16
+        # weights must land under dt=bfloat16, the key the engine's
+        # lookup queries (a float32 default would be a silent miss)
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("PADDLE_TPU_TUNE", "cached")
+        p16 = {k: (jnp.asarray(v, jnp.bfloat16)
+                   if (k.startswith("block") or k.startswith("lm_head"))
+                   and k.endswith(".w") else v)
+               for k, v in params.items()}
+        rep16 = tune.tune_serving_decode(p16, NL, NH, DM, max_len=T)
+        assert "dt=bfloat16" in rep16["key"]
+    finally:
+        tune.reset_cache()

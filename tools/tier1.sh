@@ -148,8 +148,12 @@ print('bench history:', json.dumps(row))
     exit 1
 fi
 # serving smoke: the continuous-batching engine must beat the sequential
-# single-stream baseline (asserted inside --smoke) and print ONE
-# parseable JSON row with the throughput/latency/compile fields
+# single-stream baseline, SLO-scheduled goodput must beat the FIFO
+# baseline's goodput under the same shared-prefix Poisson load, and the
+# paged prefix-reuse cache must hit (prefix_hit_rate > 0, strictly fewer
+# prefill tokens than reuse-off) — all asserted inside --smoke — and the
+# script must print ONE parseable JSON row with the
+# throughput/latency/goodput/prefix/compile fields
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
         python benchmarks/serving.py --smoke \
         > /tmp/_t1_serving.json 2> /tmp/_t1_serving.log; then
@@ -165,7 +169,9 @@ assert len(rows) == 1, f'expected ONE json line, got {len(rows)}'
 row = rows[0]
 for k in ('tok_s', 'baseline_tok_s', 'speedup', 'ttft_p50_ms',
           'e2e_p99_ms', 'prefill_compiles', 'decode_compiles',
-          'goodput_under_slo', 'slo_violations'):
+          'goodput_under_slo', 'slo_violations', 'prefix_hit_rate',
+          'shed_total', 'fifo_goodput_under_slo', 'prefill_tokens',
+          'fifo_prefill_tokens', 'cow_copies'):
     assert k in row, f'missing field {k}: {row}'
 print('serving smoke:', json.dumps(row))
 "; then
