@@ -372,6 +372,16 @@ def _bench_gpt_at(seq, n_chips, mesh_factory, steps, warmup, extra):
     cap = device_hbm_bytes(jax.devices()[0])
     extra["gpt_hbm_high_water_bytes"] = high
     extra["gpt_temp_bytes"] = cost0.get("temp_bytes")
+    # which kernel-registry backend each op class of the flagship step
+    # resolved to (docs/kernels.md) — bench-history can segment the
+    # trajectory by backend, and a lint error here means interpret-mode
+    # kernels leaked into this timed run
+    if cost0.get("kernel_backends"):
+        extra["gpt_kernel_backends"] = cost0["kernel_backends"]
+    if cost0.get("interpret_in_timed_run"):
+        extra["gate_flagship_gpt_backend"] = (
+            "FAILED: interpret-mode kernels in a timed run "
+            "(jaxpr.kernel-backend)")
     if mesh is not None:
         # multi-chip comm accounting of the compiled step (the full
         # scaling story lives in benchmarks/multichip.py; these ride the
@@ -481,9 +491,13 @@ def flash_numeric_gate():
     err over all shapes (driver records it in BENCH json)."""
     import jax
     import jax.numpy as jnp
-    from paddle_tpu.ops.pallas_attention import (
-        attention_reference, flash_attention)
+    from paddle_tpu.kernels import get_kernel
+    from paddle_tpu.ops.pallas_attention import flash_attention
 
+    # ONE oracle for every numeric gate: the registry's xla_ref backend
+    # (kernels/xla_ref.py) — the same reference the cross-backend
+    # oracle suite tests against (docs/kernels.md)
+    oracle = get_kernel("flash_attention", "xla_ref").impl
     worst = 0.0
     with jax.default_matmul_precision("highest"):
         for (b, t, h, d, bq, bk, causal) in [
@@ -496,7 +510,7 @@ def flash_numeric_gate():
                                    jnp.float32) for _ in range(3))
             o = flash_attention(q, k, v, causal=causal, block_q=bq,
                                 block_k=bk)
-            ref = attention_reference(q, k, v, causal=causal)
+            ref = oracle.call(q, k, v, causal=causal)
             scale = float(jnp.max(jnp.abs(ref))) or 1.0
             err = float(jnp.max(jnp.abs(o - ref))) / scale
             worst = max(worst, err)
@@ -514,11 +528,12 @@ def grad_numeric_gates():
     {gate_name: max_rel_err}; asserts sane bounds."""
     import jax
     import jax.numpy as jnp
-    from paddle_tpu.ops.pallas_attention import (
-        attention_reference, flash_attention_packed)
-    from paddle_tpu.ops.pallas_ce import (
-        fused_softmax_ce_head, fused_softmax_ce_head_reference)
+    from paddle_tpu.kernels import get_kernel
+    from paddle_tpu.ops.pallas_attention import flash_attention_packed
+    from paddle_tpu.ops.pallas_ce import fused_softmax_ce_head
 
+    attn_oracle = get_kernel("flash_attention", "xla_ref").impl
+    ce_oracle = get_kernel("fused_ce", "xla_ref").impl
     out = {}
     rng = np.random.default_rng(23)
     # flash backward at the PRODUCTION geometry (bf16 inputs, 1024
@@ -545,7 +560,7 @@ def grad_numeric_gates():
     def loss_dense(q, k, v):
         q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
         with jax.default_matmul_precision("highest"):
-            o = attention_reference(q, k, v, causal=True)
+            o = attn_oracle.call(q, k, v, causal=True)
         return jnp.sum(o.reshape(b, t, h * d) * wgt)
 
     gf = jax.grad(loss_flash, (0, 1, 2))(pk(q4), pk(k4), pk(v4))
@@ -575,7 +590,7 @@ def grad_numeric_gates():
     def loss_ref(x, w):
         x, w = x.astype(jnp.float32), w.astype(jnp.float32)
         with jax.default_matmul_precision("highest"):
-            return jnp.sum(fused_softmax_ce_head_reference(x, w, y) * gvec)
+            return jnp.sum(ce_oracle.call(x, w, y) * gvec)
 
     lf = loss_fused(x, w)
     lr = loss_ref(x, w)
@@ -934,26 +949,39 @@ def _main(extra, errors):
         except Exception as e:  # noqa: BLE001 — isolated like the gates
             errors["gpt_tune"] = _err_str(e)
 
+    # Declare the flagship sections a TIMED-RUN region (one selection
+    # path, docs/kernels.md): kernel routing stays the registry's —
+    # native kernels on this accelerator, explicit env overrides
+    # honored — and the jaxpr.kernel-backend lint turns any
+    # interpret-mode Pallas call compiled inside this window into an
+    # error on the row instead of a silently-wrong timing.  This
+    # replaces the old ad-hoc per-call-site
+    # ``interpret = jax.default_backend() != "tpu"`` fallbacks as the
+    # bench's kernel-selection story.
+    from paddle_tpu.kernels import timed_run
+
     img_per_chip = None
     tok_per_chip = None
-    if "resnet" in which:
-        try:
-            img_per_chip, img_min, img_max = bench_resnet(
-                n_chips, mesh_factory, steps, warmup, extra=extra)
-            extra["resnet_img_s_min"] = round(img_min, 1)
-            extra["resnet_img_s_max"] = round(img_max, 1)
-        except Exception as e:
-            errors["resnet"] = _err_str(e)
-    if "gpt" in which:
-        try:
-            tok_per_chip, mfu, tok_min, tok_max = bench_gpt(
-                n_chips, mesh_factory, steps, warmup, extra=extra)
-            extra["gpt_tokens_per_sec_per_chip"] = round(tok_per_chip, 1)
-            extra["gpt_mfu"] = round(mfu, 4)
-            extra["gpt_tok_s_min"] = round(tok_min, 1)
-            extra["gpt_tok_s_max"] = round(tok_max, 1)
-        except Exception as e:
-            errors["gpt"] = _err_str(e)
+    with timed_run():
+        if "resnet" in which:
+            try:
+                img_per_chip, img_min, img_max = bench_resnet(
+                    n_chips, mesh_factory, steps, warmup, extra=extra)
+                extra["resnet_img_s_min"] = round(img_min, 1)
+                extra["resnet_img_s_max"] = round(img_max, 1)
+            except Exception as e:
+                errors["resnet"] = _err_str(e)
+        if "gpt" in which:
+            try:
+                tok_per_chip, mfu, tok_min, tok_max = bench_gpt(
+                    n_chips, mesh_factory, steps, warmup, extra=extra)
+                extra["gpt_tokens_per_sec_per_chip"] = round(
+                    tok_per_chip, 1)
+                extra["gpt_mfu"] = round(mfu, 4)
+                extra["gpt_tok_s_min"] = round(tok_min, 1)
+                extra["gpt_tok_s_max"] = round(tok_max, 1)
+            except Exception as e:
+                errors["gpt"] = _err_str(e)
     gates_failed = run_gates(extra)
     if os.environ.get("BENCH_INFER", "").lower() in ("1", "true", "yes"):
         # serving-side rows (benchmarks/inference.py) ride along in the

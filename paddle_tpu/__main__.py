@@ -1380,6 +1380,23 @@ def cmd_tune_selftest(args=None):
     return run_selftest()
 
 
+def cmd_kernels_selftest(args=None):
+    """``python -m paddle_tpu --kernels-selftest``: the multi-backend
+    kernel registry's CI gate (docs/kernels.md) — registry resolution
+    and override precedence on this host, oracle parity for every
+    available backend (plus the Mosaic/triton kernels force-run in
+    interpret mode) against the pure-XLA reference within the
+    documented ``ORACLE_TOL`` bounds (f32+bf16, causal/non-causal,
+    d_head 64/128, grads through the custom-vjp, run-to-run
+    bit-exactness), the ``PADDLE_TPU_KERNEL_BACKEND=xla_ref`` GPT
+    trainer path with zero Pallas calls under every memory_optimize
+    policy, and the interpret-mode-in-timed-run lint finding planted
+    and detected.  Wired into tools/tier1.sh."""
+    from .kernels.selftest import run_selftest
+
+    return run_selftest()
+
+
 def cmd_resilience_selftest(args=None):
     """``python -m paddle_tpu --resilience-selftest``: the elastic
     resilience engine's CI gate — a trainer subprocess on the 8-device
@@ -1415,6 +1432,8 @@ def main(argv=None):
         return cmd_resilience_selftest()
     if "--tune-selftest" in argv:
         return cmd_tune_selftest()
+    if "--kernels-selftest" in argv:
+        return cmd_kernels_selftest()
     if "--attribution-selftest" in argv:
         return cmd_attribution_selftest()
     if "--bench-history" in argv:

@@ -430,11 +430,19 @@ def lint(program=None, feed=None, fetch_list=None, scope=None,
 
 def compile_findings(program=None, fetch_names=(), compiled=None,
                      memstats=None, comm=None, in_loop_expected=False,
-                     donate=True, hbm_budget=None):
+                     donate=True, hbm_budget=None, kernel_backends=None):
     """The Executor's compile-time fold-in: run the program-level checks
     plus the hlo-level checks over artifacts the compile already
     produced (no extra trace or compile).  Returns a list of Findings —
-    the Executor summarizes them into ``last_step_cost``."""
+    the Executor summarizes them into ``last_step_cost``.
+
+    ``kernel_backends`` is the kernel registry's per-op-class resolution
+    snapshot of this compile (``last_step_cost["kernel_backends"]``):
+    the jaxpr-level ``jaxpr.kernel-backend`` check needs a traced jaxpr
+    the fold-in deliberately does not produce, so its timed-run form is
+    evaluated here from the snapshot alone — Mosaic backends resolved
+    on a non-TPU platform inside a timed-run region mean interpret-mode
+    kernels in a timed measurement (docs/kernels.md)."""
     ctx = CheckContext(
         program, fetch_list=list(fetch_names), donate=donate,
         hbm_budget=hbm_budget, in_loop_expected=in_loop_expected)
@@ -454,7 +462,42 @@ def compile_findings(program=None, fetch_names=(), compiled=None,
     report = _run_checks(ctx, specs, AnalysisReport())
     # artifact-skip notes are lint() UX; the fold-in only wants real
     # findings
-    return [f for f in report if f.check != "analysis.artifact"]
+    findings = [f for f in report if f.check != "analysis.artifact"]
+    findings += _timed_run_backend_findings(kernel_backends)
+    return findings
+
+
+def _timed_run_backend_findings(kernel_backends):
+    """The registry-snapshot form of ``jaxpr.kernel-backend``: inside a
+    timed-run region, any op class resolved to an interpret-mode Mosaic
+    backend (``pallas_tpu`` off-TPU) is an error — the timed row would
+    ship a simulation, not a measurement."""
+    if not kernel_backends:
+        return []
+    try:
+        import jax
+
+        from ..kernels import timed_run_active
+
+        if not timed_run_active() or jax.default_backend() == "tpu":
+            return []
+    except Exception:  # noqa: BLE001 — lint must never block a compile
+        return []
+    ops = sorted(op for op, b in kernel_backends.items()
+                 if b == "pallas_tpu")
+    if not ops:
+        return []
+    return [Finding(
+        "jaxpr.kernel-backend", "error", "jaxpr", "kernel registry",
+        f"op class(es) {', '.join(ops)} resolved to pallas_tpu on a "
+        f"non-TPU platform inside a timed-run region — the kernels run "
+        f"in Pallas interpret mode, so the timing is a simulation "
+        f"artifact, not a measurement",
+        hint="route timed off-TPU runs to the XLA reference "
+             "(PADDLE_TPU_KERNEL_BACKEND=xla_ref or a per-op "
+             "PADDLE_TPU_KERNEL_BACKEND_<OP> override) or run on the "
+             "hardware the kernels target",
+        data={"kernel_backends": dict(kernel_backends)})]
 
 
 def preflight_hbm(high_water_bytes, budget_bytes, context=""):

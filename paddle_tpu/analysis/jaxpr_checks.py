@@ -115,6 +115,36 @@ def kernel_residual(ctx):
     return findings
 
 
+@register_check("jaxpr.kernel-backend", level="jaxpr")
+def kernel_backend(ctx):
+    """Interpret-mode kernels in a TIMED run (docs/kernels.md): inside
+    a declared timed-run region (``kernels.timed_run()`` — bench.py
+    wraps its flagship sections; PADDLE_TPU_TIMED_RUN=1) any
+    ``pallas_call`` with ``interpret=True`` is an error — the Pallas
+    interpreter is orders of magnitude slower than both hardware and
+    the pure-XLA reference, so the "measurement" is a simulation
+    artifact, not a number.  Outside timed regions interpret kernels
+    are the DESIRED CPU test path and this check stays silent."""
+    from ..kernels import timed_run_active
+
+    if not timed_run_active():
+        return []
+    rep = ctx.walk
+    if not rep["pallas_interpret"]:
+        return []
+    return [ctx.finding(
+        "jaxpr.kernel-backend", "error", "jaxpr", "pallas_call",
+        f"{rep['pallas_interpret']} of {rep['pallas_total']} kernel "
+        f"calls run in Pallas INTERPRET mode inside a timed-run region "
+        f"— interpreted kernels are not a measurement",
+        hint="route timed off-TPU runs through the registry's XLA "
+             "reference (PADDLE_TPU_KERNEL_BACKEND=xla_ref, or a "
+             "per-op PADDLE_TPU_KERNEL_BACKEND_<OP> override) or run "
+             "on the hardware the kernel targets",
+        data={"interpret": rep["pallas_interpret"],
+              "total": rep["pallas_total"]})]
+
+
 @register_check("jaxpr.bf16-accum", level="jaxpr")
 def bf16_accum(ctx):
     """Reduced-precision accumulation lint: an ``acc = acc + delta``

@@ -148,6 +148,7 @@ def walk_report(jaxpr, layer_counts=()):
         "pallas_calls": [],
         "pallas_total": 0,
         "pallas_outside_scan": 0,
+        "pallas_interpret": 0,
         "scan_lengths": [],
         "layer_stacked_pallas": [],
         "residual_stacks": [],
@@ -168,6 +169,11 @@ def walk_report(jaxpr, layer_counts=()):
                 report["pallas_calls"].append(
                     {"scan_depth": depth, "shapes": shapes})
                 report["pallas_total"] += 1
+                if eqn.params.get("interpret"):
+                    # interpret-mode kernel: exact logic, simulated
+                    # speed — the jaxpr.kernel-backend check flags
+                    # these inside timed-run regions
+                    report["pallas_interpret"] += 1
                 if depth == 0:
                     report["pallas_outside_scan"] += 1
                 if layer_counts:

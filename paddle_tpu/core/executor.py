@@ -488,16 +488,28 @@ class Executor:
         ``lint_errors`` / ``lint_checks`` — PADDLE_TPU_LINT=0 disables).
         Returns ``(fn, cost)``."""
         reg = _obs.get_registry()
+        # kernel-registry recording: resolutions happen at trace time
+        # (inside .lower()), so resetting here scopes the snapshot to
+        # THIS compile — last_step_cost["kernel_backends"] then says
+        # which kernel backend each op class of this executable runs
+        # (docs/kernels.md; the attribution workload key carries the
+        # flash choice as its |kb= token)
+        from ..kernels import registry as _kreg
+
+        _kreg.reset_selected()
         t0 = time.perf_counter()
         with self._rng_invariant_ctx():
             compiled = jitted.lower(*args).compile()
         dt = time.perf_counter() - t0
+        kernel_backends = _kreg.selected_backends()
         reg.counter(
             "executor.compile_count",
             help="programs compiled (jit cache misses)").inc()
         reg.histogram("executor.compile_seconds").observe(dt)
         cost = {"label": label, "compile_seconds": dt,
                 "flops": None, "bytes_accessed": None}
+        if kernel_backends:
+            cost["kernel_backends"] = kernel_backends
         try:
             ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):
@@ -620,7 +632,8 @@ class Executor:
                     compiled=compiled, memstats=memstats or None,
                     comm=comm if self.mesh is not None else {},
                     in_loop_expected=label.startswith("scan"),
-                    donate=self.donate_state)
+                    donate=self.donate_state,
+                    kernel_backends=kernel_backends)
             except Exception:  # noqa: BLE001 — lint must never block a run
                 findings = []
             cost["lint_findings"] = len(findings)
@@ -629,6 +642,11 @@ class Executor:
             if findings:
                 cost["lint_checks"] = sorted(
                     {f.check for f in findings})[:8]
+            if any(f.check == "jaxpr.kernel-backend" for f in findings):
+                # dedicated flag for the timed-run gates (bench,
+                # kernels selftest): lint_checks caps at 8 names, so
+                # membership there is not a reliable signal
+                cost["interpret_in_timed_run"] = True
         return compiled, cost
 
     # ------------------------------------------------------------------

@@ -1,0 +1,51 @@
+"""paddle_tpu.kernels — the multi-backend kernel registry
+(docs/kernels.md, ROADMAP item 2).
+
+One op, three targets, one numerics oracle: every fused op class
+(``flash_attention``, ``fused_ce``, ``decode_gather``) resolves through
+:mod:`.registry` to one of ``pallas_tpu`` (the Mosaic kernels — native
+on TPU, interpret mode in CPU tests), ``triton`` (the same block
+schedules lowered GPU-style — :mod:`.triton_attention` /
+:mod:`.triton_ce`), or ``xla_ref`` (:mod:`.xla_ref` — the shape-
+complete pure-XLA reference every backend is tested against, with the
+documented cross-backend tolerances in ``ORACLE_TOL``).
+
+Selection: ``PADDLE_TPU_KERNEL_BACKEND=auto|pallas_tpu|triton|xla_ref``
+(global), ``PADDLE_TPU_KERNEL_BACKEND_<OP>`` (per op class), explicit
+``backend=`` call-site arguments, or the tuned winner's persisted
+kernel choice — precedence and fallback semantics in
+:mod:`.registry`.  CI: ``python -m paddle_tpu --kernels-selftest``
+(tools/tier1.sh) and ``tests/test_kernels.py``.
+"""
+
+from . import registry  # must load first: backend modules register into it
+from .registry import (
+    AUTO_ORDER, BACKENDS, GLOBAL_ENV, TIMED_RUN_ENV, KernelUnavailable,
+    available_backends, forced_backend, get_kernel,
+    registered_op_classes, reset_selected, resolve, resolve_name,
+    selected_backends, timed_run, timed_run_active)
+from .xla_ref import ORACLE_TOL, oracle_tol
+from . import xla_ref  # registers the oracle backend
+from . import triton_attention, triton_ce  # register the GPU backends
+from . import pallas_gather  # registers the TPU decode gather
+
+__all__ = [
+    "AUTO_ORDER", "BACKENDS", "GLOBAL_ENV", "TIMED_RUN_ENV",
+    "KernelUnavailable", "ORACLE_TOL", "available_backends",
+    "forced_backend", "get_kernel", "oracle_tol",
+    "registered_op_classes", "reset_selected", "resolve",
+    "resolve_name", "selected_backends", "timed_run",
+    "timed_run_active",
+]
+
+# The pallas_tpu flash/CE backends register from the op modules
+# themselves (they own the kernels).  Importing them here makes a bare
+# ``import paddle_tpu.kernels`` self-sufficient; inside the package's
+# own import cycle they may arrive partially initialized, in which case
+# their bottom-of-module registration still runs when the outer import
+# completes.
+try:  # noqa: SIM105
+    from ..ops import pallas_attention as _pa  # noqa: F401
+    from ..ops import pallas_ce as _pce  # noqa: F401
+except ImportError:  # pragma: no cover — mid-bootstrap partial import
+    pass

@@ -1,0 +1,423 @@
+"""Flash attention lowered GPU-style — the ``triton`` registry backend.
+
+Same block schedule as the Mosaic kernels (``ops/pallas_attention.py``:
+online softmax over k blocks, causal block skip, backward recomputed
+from the narrow lse residual), re-lowered for the GPU execution model:
+
+* TPU grids run SEQUENTIALLY, so the TPU kernels put the k axis in the
+  grid and carry softmax state in VMEM scratch across grid steps.  GPU
+  grids are PARALLEL — each program id is an independent CTA — so here
+  the grid covers only independent work (one (batch*head, q-block) or
+  (batch*head, k-block) cell) and the reduction loop runs INSIDE the
+  kernel body (``lax.fori_loop`` with the online-softmax state as loop
+  carry, k/v blocks loaded per iteration with ``pl.load`` +
+  ``pl.dslice``).  This is the standard Triton flash decomposition
+  (triton_guide.md), written as Pallas so jax's Triton backend lowers
+  it on GPU and the interpreter runs the identical logic in CPU tests.
+* Causal cells above the diagonal are skipped by bounding the loop
+  (``hi = ceil(((j+1)*bq) / bk)`` clamped), and the iota mask runs on
+  every visited block — per-sub-tile mask elision (the TPU DIAG_W
+  machinery) buys little on GPU where the mask is a fused vector op.
+* Backward = dq kernel (q-block grid, loop over k) + dk/dv kernel
+  (k-block grid, loop over q); ``delta = rowsum(do*o)`` inside, the
+  optional lse cotangent folded in exactly like the TPU kernels.
+
+Layout: the packed-by-transpose core ``q/k/v [b*h, t, d]``; lse is the
+2-D ``[b*h, t]`` f32 residual (same contract — ``FLASH_BWD_RESIDUALS``
+— as the Mosaic kernels, so memory_optimize name policies treat both
+identically).  Registered available only where a GPU backend exists;
+CPU oracle tests run these kernels under ``interpret=True``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+from ..analysis.jaxpr_tools import KERNEL_RESIDUAL_TAG
+from ..ops.pallas_attention import _pick_block
+from .registry import register_kernel
+
+NEG_INF = -1e30
+
+# GPU SRAM is ~100x smaller than the problem; the canonical Triton
+# flash tile is 64-128 square.  Caller block hints are honored but
+# capped here — "the same block schedule" means the same loop
+# structure and skip predicate, not the same 1024-wide VMEM tiles.
+MAX_BLOCK = 128
+
+
+def _blocks(t_q, t_k, block_q, block_k):
+    bq = _pick_block(t_q, min(int(block_q or MAX_BLOCK), MAX_BLOCK))
+    bk = _pick_block(t_k, min(int(block_k or MAX_BLOCK), MAX_BLOCK))
+    return bq, bk
+
+
+def _causal_hi(j, block_q, block_k, nk):
+    """Number of k blocks a causal q block ``j`` touches (the TPU
+    kernels' ``last_kb`` clamp, as a loop bound)."""
+    return jnp.minimum(((j + 1) * block_q - 1) // block_k + 1, nk)
+
+
+def _mask(s, q0, k0, wq, wk):
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (wq, wk), 0)
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (wq, wk), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
+                causal, block_q, block_k, nk):
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    q = q_ref[0]                                        # [bq, d]
+    d = q.shape[-1]
+
+    def body(kb, carry):
+        m, l, acc = carry
+        cols = (pl.dslice(0, 1), pl.dslice(kb * block_k, block_k),
+                slice(None))
+        kb_t = pl.load(k_ref, cols)[0]
+        vb_t = pl.load(v_ref, cols)[0]
+        s = jax.lax.dot_general(
+            q, kb_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _mask(s, j * block_q, kb * block_k, block_q, block_k)
+        m2 = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m2)
+        p = jnp.exp(s - m2[:, None])
+        l2 = l * alpha + jnp.sum(p, axis=-1)
+        acc2 = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(vb_t.dtype), vb_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m2, l2, acc2
+
+    hi = _causal_hi(j, block_q, block_k, nk) if causal else nk
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q, block_k = _blocks(t_q, t_k, block_q, block_k)
+    nk = t_k // block_k
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(bh, t_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t_k, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, nk,
+                   has_dlse):
+    import jax.experimental.pallas as pl
+
+    if has_dlse:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dlse_ref,
+         dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref = refs
+        dlse_ref = None
+    j = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]                                    # [bq]
+    d = q.shape[-1]
+    delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                    axis=-1)
+    if dlse_ref is not None:
+        delta = delta - dlse_ref[0]
+
+    def body(kb, dq):
+        cols = (pl.dslice(0, 1), pl.dslice(kb * block_k, block_k),
+                slice(None))
+        kb_t = pl.load(k_ref, cols)[0]
+        vb_t = pl.load(v_ref, cols)[0]
+        s = jax.lax.dot_general(
+            q, kb_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _mask(s, j * block_q, kb * block_k, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, vb_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(kb_t.dtype)
+        return dq + jax.lax.dot_general(
+            ds, kb_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    hi = _causal_hi(j, block_q, block_k, nk) if causal else nk
+    dq = jax.lax.fori_loop(0, hi, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, nq,
+                    has_dlse):
+    import jax.experimental.pallas as pl
+
+    if has_dlse:
+        (k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref, dlse_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
+         dk_ref, dv_ref) = refs
+        dlse_ref = None
+    kb = pl.program_id(1)
+    k = k_ref[0]
+    v = v_ref[0]
+    d = k.shape[-1]
+
+    def body(jq, carry):
+        dk, dv = carry
+        rows = (pl.dslice(0, 1), pl.dslice(jq * block_q, block_q),
+                slice(None))
+        lrows = (pl.dslice(0, 1), pl.dslice(jq * block_q, block_q))
+        qb = pl.load(q_ref, rows)[0]
+        dob = pl.load(do_ref, rows)[0]
+        ob = pl.load(o_ref, rows)[0]
+        lse = pl.load(lse_ref, lrows)[0]
+        delta = jnp.sum(
+            dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1)
+        if dlse_ref is not None:
+            delta = delta - pl.load(dlse_ref, lrows)[0]
+        s = jax.lax.dot_general(
+            qb, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _mask(s, jq * block_q, kb * block_k, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dv2 = dv + jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            dob, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(qb.dtype)
+        dk2 = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk2, dv2
+
+    # causal: q block jq touches k block kb iff its last row reaches the
+    # block diagonal — start the loop there, skip the rest entirely
+    lo = (kb * block_k) // block_q if causal else 0
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+               interpret, dlse=None):
+    import jax.experimental.pallas as pl
+
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q, block_k = _blocks(t_q, t_k, block_q, block_k)
+    nq = t_q // block_q
+    nk = t_k // block_k
+    has_dlse = dlse is not None
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+    kfull = pl.BlockSpec((1, t_k, d), lambda i, j: (i, 0, 0))
+    lspec = pl.BlockSpec((1, block_q), lambda i, j: (i, j))
+    dq_specs = [qspec, kfull, kfull, qspec, qspec, lspec]
+    dq_args = [q, k, v, do, o, lse]
+    if has_dlse:
+        dq_specs.append(lspec)
+        dq_args.append(dlse)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k, nk=nk, has_dlse=has_dlse),
+        grid=(bh, nq),
+        in_specs=dq_specs,
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        interpret=interpret,
+    )(*dq_args)[0]
+
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0))
+    qfull = pl.BlockSpec((1, t_q, d), lambda i, kb: (i, 0, 0))
+    lfull = pl.BlockSpec((1, t_q), lambda i, kb: (i, 0))
+    dkv_specs = [kspec, kspec, qfull, qfull, qfull, lfull]
+    dkv_args = [k, v, q, do, o, lse]
+    if has_dlse:
+        dkv_specs.append(lfull)
+        dkv_args.append(dlse)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k, nq=nq, has_dlse=has_dlse),
+        grid=(bh, nk),
+        in_specs=dkv_specs,
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=interpret,
+    )(*dkv_args)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _triton_core(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                      interpret)
+    return o
+
+
+def _triton_core_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                     interpret):
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret)
+    # the FLASH_BWD_RESIDUALS contract, backend-invariant
+    o = checkpoint_name(o, KERNEL_RESIDUAL_TAG)
+    lse = checkpoint_name(lse, KERNEL_RESIDUAL_TAG)
+    return o, (q, k, v, o, lse)
+
+
+def _triton_core_bwd(sm_scale, causal, block_q, block_k, interpret, res,
+                     do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q,
+                      block_k, interpret)
+
+
+_triton_core.defvjp(_triton_core_fwd, _triton_core_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _triton_core_lse(q, k, v, sm_scale, causal, block_q, block_k,
+                     interpret):
+    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                      interpret)
+
+
+def _triton_core_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                         interpret):
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret)
+    o = checkpoint_name(o, KERNEL_RESIDUAL_TAG)
+    lse = checkpoint_name(lse, KERNEL_RESIDUAL_TAG)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _triton_core_lse_bwd(sm_scale, causal, block_q, block_k, interpret,
+                         res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    return _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q,
+                      block_k, interpret,
+                      dlse=dlse.astype(jnp.float32))
+
+
+_triton_core_lse.defvjp(_triton_core_lse_fwd, _triton_core_lse_bwd)
+
+
+def _default_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() not in ("gpu", "cuda", "rocm")
+    return bool(interpret)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=None,
+                    block_k=None, interpret=None):
+    """4-D entry (``[b, t, h, d]``): pack by transpose (cheap on GPU —
+    a layout change, not the TPU's 8%-of-step tax) and run the core."""
+    interpret = _default_interpret(interpret)
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+
+    def pack(x, t):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, t, x.shape[-1])
+
+    o = _triton_core(pack(q, t_q), pack(k, t_k), pack(v, t_k),
+                     float(sm_scale), bool(causal),
+                     block_q and int(block_q), block_k and int(block_k),
+                     interpret)
+    return jnp.swapaxes(o.reshape(b, h, t_q, d), 1, 2)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
+                             block_q=None, block_k=None, interpret=None):
+    interpret = _default_interpret(interpret)
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+
+    def pack(x, t):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, t, x.shape[-1])
+
+    o, lse = _triton_core_lse(
+        pack(q, t_q), pack(k, t_k), pack(v, t_k), float(sm_scale),
+        bool(causal), block_q and int(block_q),
+        block_k and int(block_k), interpret)
+    return (jnp.swapaxes(o.reshape(b, h, t_q, d), 1, 2),
+            lse.reshape(b, h, t_q))
+
+
+def flash_attention_packed(q, k, v, n_head, causal=False, sm_scale=None,
+                           block_q=None, block_k=None, interpret=None):
+    """Packed layout ``[b, t, h*d]``: the head split is a reshape +
+    transpose here (no Mosaic lane-slice constraint), so every head
+    width is supported."""
+    b, t, hd = q.shape
+    if hd % n_head:
+        raise ValueError(
+            f"feature dim {hd} not divisible by n_head {n_head}")
+    d = hd // n_head
+    r4 = lambda x: x.reshape(b, x.shape[1], n_head, d)
+    o = flash_attention(r4(q), r4(k), r4(v), causal=causal,
+                        sm_scale=sm_scale, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
+    return o.reshape(b, t, hd)
+
+
+def _gpu_available():
+    try:
+        backend = jax.default_backend()
+    except Exception as e:  # noqa: BLE001
+        return False, f"jax backend probe failed: {e}"
+    if backend in ("gpu", "cuda", "rocm"):
+        return True, ""
+    return False, (f"no GPU on this host (platform {backend!r}); "
+                   f"CPU tests run these kernels with interpret=True")
+
+
+class _FlashTriton:
+    call = staticmethod(flash_attention)
+    call_with_lse = staticmethod(flash_attention_with_lse)
+    call_packed = staticmethod(flash_attention_packed)
+
+
+register_kernel("flash_attention", "triton", _FlashTriton,
+                available=_gpu_available)

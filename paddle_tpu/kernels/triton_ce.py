@@ -1,0 +1,289 @@
+"""Fused CE/LSE head lowered GPU-style — the ``triton`` registry
+backend for the ``fused_ce`` op class.
+
+Same schedule as ``ops/pallas_ce.py`` (vocab-tiled online softmax, the
+label logit picked by an iota==label select, backward recomputed from
+the saved per-row lse), re-lowered for parallel GPU grids: the grid
+covers independent row blocks (fwd/dx) or vocab blocks (dW) and the
+reduction loop runs INSIDE the kernel (``lax.fori_loop`` + ``pl.load``
+vocab/row tiles) instead of carrying scratch across sequential grid
+steps.  See ``kernels/triton_attention.py`` for the execution-model
+rationale; registered available only where a GPU exists, and CPU
+oracle tests run the identical logic under ``interpret=True``."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.ad_checkpoint import checkpoint_name
+
+from ..analysis.jaxpr_tools import KERNEL_RESIDUAL_TAG
+from ..ops.pallas_attention import _pick_block
+from .registry import register_kernel
+from .triton_attention import _gpu_available
+
+MAX_BLOCK_N = 128
+MAX_BLOCK_V = 1024
+
+
+def _blocks(n, v, block_n, block_v):
+    bn = _pick_block(n, min(int(block_n or MAX_BLOCK_N), MAX_BLOCK_N))
+    bv = _pick_block(v, min(int(block_v or MAX_BLOCK_V), MAX_BLOCK_V))
+    return bn, bv
+
+
+def _ce_fwd_kernel(x_ref, w_ref, y_ref, loss_ref, lse_ref, *, block_v,
+                   nv):
+    import jax.experimental.pallas as pl
+
+    x = x_ref[...]                                      # [bn, d]
+    y = y_ref[...]                                      # [bn, 1]
+    bn = x.shape[0]
+
+    def body(jv, carry):
+        m, l, picked = carry
+        wb = pl.load(w_ref, (slice(None), pl.dslice(jv * block_v,
+                                                    block_v)))
+        s = jax.lax.dot_general(
+            x, wb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bn, bv]
+        m2 = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m2)
+        p = jnp.exp(s - m2[:, None])
+        l2 = l * alpha + jnp.sum(p, axis=-1)
+        col = jv * block_v + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        picked2 = picked + jnp.sum(
+            jnp.where(col == y, s, 0.0), axis=-1)
+        return m2, l2, picked2
+
+    m0 = jnp.full((bn,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bn,), jnp.float32)
+    pick0 = jnp.zeros((bn,), jnp.float32)
+    m, l, picked = jax.lax.fori_loop(0, nv, body, (m0, l0, pick0))
+    lse = m + jnp.log(l)
+    lse_ref[...] = lse[:, None]
+    loss_ref[...] = (lse - picked)[:, None]
+
+
+def _ce_dx_kernel(x_ref, w_ref, y_ref, lse_ref, geff_ref, gpick_ref,
+                  dx_ref, *, block_v, nv):
+    import jax.experimental.pallas as pl
+
+    x = x_ref[...]
+    y = y_ref[...]
+    lse = lse_ref[...]                                  # [bn, 1]
+    geff = geff_ref[...]
+    gpick = gpick_ref[...]
+    d = x.shape[1]
+
+    def body(jv, dx):
+        wb = pl.load(w_ref, (slice(None), pl.dslice(jv * block_v,
+                                                    block_v)))
+        s = jax.lax.dot_general(
+            x, wb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse)
+        col = jv * block_v + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        onehot = (col == y).astype(jnp.float32)
+        ds = (p * geff - onehot * gpick).astype(wb.dtype)
+        return dx + jax.lax.dot_general(
+            ds, wb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dx = jax.lax.fori_loop(
+        0, nv, body, jnp.zeros((x.shape[0], d), jnp.float32))
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _ce_dw_kernel(x_ref, w_ref, y_ref, lse_ref, geff_ref, gpick_ref,
+                  dw_ref, *, block_n, block_v, nn):
+    import jax.experimental.pallas as pl
+
+    jv = pl.program_id(0)
+    wb = w_ref[...]                                     # [d, bv]
+    d = wb.shape[0]
+
+    def body(jn, dw):
+        rows = pl.dslice(jn * block_n, block_n)
+        x = pl.load(x_ref, (rows, slice(None)))
+        y = pl.load(y_ref, (rows, slice(None)))
+        lse = pl.load(lse_ref, (rows, slice(None)))
+        geff = pl.load(geff_ref, (rows, slice(None)))
+        gpick = pl.load(gpick_ref, (rows, slice(None)))
+        s = jax.lax.dot_general(
+            x, wb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse)
+        col = jv * block_v + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        onehot = (col == y).astype(jnp.float32)
+        ds = (p * geff - onehot * gpick).astype(x.dtype)
+        return dw + jax.lax.dot_general(
+            x, ds, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dw = jax.lax.fori_loop(
+        0, nn, body, jnp.zeros((d, wb.shape[1]), jnp.float32))
+    dw_ref[...] = dw.astype(dw_ref.dtype)
+
+
+def _ce_fwd(x, w, y, block_n, block_v, interpret):
+    import jax.experimental.pallas as pl
+
+    n, d = x.shape
+    v = w.shape[1]
+    bn, bv = _blocks(n, v, block_n, block_v)
+    nv = v // bv
+    y2 = y.reshape(n, 1)
+    loss, lse = pl.pallas_call(
+        functools.partial(_ce_fwd_kernel, block_v=bv, nv=nv),
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((d, v), lambda i: (0, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, w, y2)
+    return loss[:, 0], lse[:, 0]
+
+
+def _ce_bwd(x, w, y, lse, g_eff, g_pick, block_n, block_v, interpret):
+    import jax.experimental.pallas as pl
+
+    n, d = x.shape
+    v = w.shape[1]
+    bn, bv = _blocks(n, v, block_n, block_v)
+    nn_ = n // bn
+    nv = v // bv
+    y2 = y.reshape(n, 1)
+    lse2 = lse.reshape(n, 1)
+    geff2 = g_eff.astype(jnp.float32).reshape(n, 1)
+    gpick2 = g_pick.astype(jnp.float32).reshape(n, 1)
+
+    rstat = pl.BlockSpec((bn, 1), lambda i: (i, 0))
+    dx = pl.pallas_call(
+        functools.partial(_ce_dx_kernel, block_v=bv, nv=nv),
+        grid=(nn_,),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((d, v), lambda i: (0, 0)),
+            rstat, rstat, rstat, rstat,
+        ],
+        out_specs=[pl.BlockSpec((bn, d), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype)],
+        interpret=interpret,
+    )(x, w, y2, lse2, geff2, gpick2)[0]
+
+    cstat = pl.BlockSpec((n, 1), lambda jv: (0, 0))
+    dw = pl.pallas_call(
+        functools.partial(_ce_dw_kernel, block_n=bn, block_v=bv,
+                          nn=nn_),
+        grid=(nv,),
+        in_specs=[
+            pl.BlockSpec((n, d), lambda jv: (0, 0)),
+            pl.BlockSpec((d, bv), lambda jv: (0, jv)),
+            cstat, cstat, cstat, cstat,
+        ],
+        out_specs=[pl.BlockSpec((d, bv), lambda jv: (0, jv))],
+        out_shape=[jax.ShapeDtypeStruct((d, v), w.dtype)],
+        interpret=interpret,
+    )(x, w, y2, lse2, geff2, gpick2)[0]
+    return dx, dw
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _tce_core(x, w, y, blocks, interpret):
+    loss, _ = _ce_fwd(x, w, y, blocks[0], blocks[1], interpret)
+    return loss
+
+
+def _tce_core_fwd(x, w, y, blocks, interpret):
+    loss, lse = _ce_fwd(x, w, y, blocks[0], blocks[1], interpret)
+    lse = checkpoint_name(lse, KERNEL_RESIDUAL_TAG)
+    return loss, (x, w, y, lse)
+
+
+def _tce_core_bwd(blocks, interpret, res, g):
+    x, w, y, lse = res
+    g = g.astype(jnp.float32)
+    dx, dw = _ce_bwd(x, w, y, lse, g, g, blocks[0], blocks[1],
+                     interpret)
+    return dx, dw, np.zeros(y.shape, jax.dtypes.float0)
+
+
+_tce_core.defvjp(_tce_core_fwd, _tce_core_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _tce_core_lse(x, w, y, blocks, interpret):
+    return _ce_fwd(x, w, y, blocks[0], blocks[1], interpret)
+
+
+def _tce_core_lse_fwd(x, w, y, blocks, interpret):
+    loss, lse = _ce_fwd(x, w, y, blocks[0], blocks[1], interpret)
+    lse = checkpoint_name(lse, KERNEL_RESIDUAL_TAG)
+    return (loss, lse), (x, w, y, lse)
+
+
+def _tce_core_lse_bwd(blocks, interpret, res, cts):
+    x, w, y, lse = res
+    g, glse = cts
+    g = g.astype(jnp.float32)
+    glse = glse.astype(jnp.float32)
+    # loss = lse - picked: total logits cotangent p*(g+glse) - onehot*g
+    # (kernels/xla_ref.py derivation)
+    dx, dw = _ce_bwd(x, w, y, lse, g + glse, g, blocks[0], blocks[1],
+                     interpret)
+    return dx, dw, np.zeros(y.shape, jax.dtypes.float0)
+
+
+_tce_core_lse.defvjp(_tce_core_lse_fwd, _tce_core_lse_bwd)
+
+
+def _default_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() not in ("gpu", "cuda", "rocm")
+    return bool(interpret)
+
+
+def fused_softmax_ce_head(x, w, labels, block_n=None, block_v=None,
+                          block_v_fwd=None, interpret=None):
+    """``x [n, d]``, ``w [d, v]``, ``labels [n]`` -> NLL ``[n]`` f32.
+    ``block_v_fwd`` is accepted for signature parity (the in-kernel
+    loop uses one vocab tile width)."""
+    del block_v_fwd
+    interpret = _default_interpret(interpret)
+    return _tce_core(x, w, labels.astype(jnp.int32),
+                     (block_n and int(block_n), block_v and int(block_v)),
+                     interpret)
+
+
+def fused_softmax_ce_head_with_lse(x, w, labels, block_n=None,
+                                   block_v=None, block_v_fwd=None,
+                                   interpret=None):
+    del block_v_fwd
+    interpret = _default_interpret(interpret)
+    return _tce_core_lse(
+        x, w, labels.astype(jnp.int32),
+        (block_n and int(block_n), block_v and int(block_v)), interpret)
+
+
+class _CeTriton:
+    call = staticmethod(fused_softmax_ce_head)
+    call_with_lse = staticmethod(fused_softmax_ce_head_with_lse)
+
+
+register_kernel("fused_ce", "triton", _CeTriton,
+                available=_gpu_available)

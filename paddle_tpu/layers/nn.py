@@ -657,7 +657,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False):
 
 
 def fused_softmax_ce_head(input, label, size, param_attr=None, name=None,
-                          block_n=512, block_v=1024, block_v_fwd=2048):
+                          block_n=512, block_v=1024, block_v_fwd=2048,
+                          backend=None):
     """Fused LM-head loss: projection [d -> size] + softmax cross-entropy
     in one Pallas kernel that never materializes ``[..., size]`` logits in
     HBM (``ops/pallas_ce.py``).  Replaces the composed
@@ -672,12 +673,16 @@ def fused_softmax_ce_head(input, label, size, param_attr=None, name=None,
         param_attr, shape=[in_dim, size], dtype=input.dtype, suffix="w")
     loss = helper.create_tmp_variable(
         "float32", list(input.shape[:-1]) + [1])
+    attrs = {"block_n": block_n, "block_v": block_v,
+             "block_v_fwd": block_v_fwd}
+    if backend:
+        # kernel-registry routing pin (docs/kernels.md)
+        attrs["backend"] = str(backend)
     helper.append_op(
         type="fused_softmax_ce_head",
         inputs={"X": [input.name], "W": [w.name], "Label": [label.name]},
         outputs={"Loss": [loss.name]},
-        attrs={"block_n": block_n, "block_v": block_v,
-               "block_v_fwd": block_v_fwd},
+        attrs=attrs,
     )
     return loss
 
@@ -883,7 +888,8 @@ def sequence_softmax(x, name=None):
 
 
 def flash_attention_packed(q, k, v, n_head, causal=False, sm_scale=None,
-                           block_q=None, block_k=None, name=None):
+                           block_q=None, block_k=None, backend=None,
+                           name=None):
     """Fused attention on the raw projection layout: q/k/v [b, t, h*d]
     (what the QKV matmuls emit) -> [b, t, h*d] (what the out-projection
     consumes).  No [b,t,h,d]<->[bh,t,d] pack/unpack transposes exist —
@@ -896,6 +902,11 @@ def flash_attention_packed(q, k, v, n_head, causal=False, sm_scale=None,
     out = helper.create_tmp_variable(q.dtype, q.shape)
     attrs = {"n_head": int(n_head), "causal": bool(causal),
              "sm_scale": 0.0 if sm_scale is None else float(sm_scale)}
+    if backend:
+        # kernel-registry routing (docs/kernels.md): pin this op to one
+        # backend; unset resolves env overrides then the platform auto
+        # order at trace time
+        attrs["backend"] = str(backend)
     if block_q:
         attrs["block_q"] = int(block_q)
     if block_k:
@@ -917,15 +928,18 @@ def softmax(x, name=None):
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=None,
-                    block_k=None, name=None):
-    """Fused blockwise attention (Pallas TPU kernel,
-    ops/pallas_attention.py).  q [b, t_q, h, d], k/v [b, t_k, h, d] ->
-    [b, t_q, h, d].  ``block_q``/``block_k`` tune the kernel tiles
-    (kernel defaults when omitted)."""
+                    block_k=None, backend=None, name=None):
+    """Fused blockwise attention (registry-routed: Pallas TPU kernel,
+    triton lowering, or the pure-XLA reference — docs/kernels.md).
+    q [b, t_q, h, d], k/v [b, t_k, h, d] -> [b, t_q, h, d].
+    ``block_q``/``block_k`` tune the kernel tiles (kernel defaults when
+    omitted); ``backend`` pins the kernel backend for this op."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_tmp_variable(q.dtype, q.shape)
     attrs = {"causal": bool(causal),
              "sm_scale": 0.0 if sm_scale is None else float(sm_scale)}
+    if backend:
+        attrs["backend"] = str(backend)
     if block_q:
         attrs["block_q"] = int(block_q)
     if block_k:
@@ -942,7 +956,7 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=None,
 def multi_head_attention(queries, keys, values, d_model, n_head,
                          dropout_rate=0.0, causal=False, is_test=False,
                          param_attr=None, block_q=None, block_k=None,
-                         packed=None, name=None):
+                         packed=None, backend=None, name=None):
     """Multi-head attention block: QKV projections -> fused flash
     attention (Pallas TPU kernel) -> output projection.
 
@@ -980,7 +994,8 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
     b, tq = queries.shape[0], queries.shape[1]
     tk = keys.shape[1]
     dh = d_model // n_head
-    if block_q is None and block_k is None and causal and tq == tk:
+    if (block_q is None and block_k is None and backend is None
+            and causal and tq == tk):
         # no explicit geometry: consult the autotune cache for this
         # shape's measured winner (None on miss/kill-switch — defaults)
         from ..tune import attention_config
@@ -992,6 +1007,20 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
             block_k = tuned.get("block_k")
             if packed is None:
                 packed = tuned.get("packed")
+            # a tuned winner persists its kernel choice; re-resolve it
+            # on THIS host now, non-strictly — the attr would reach
+            # resolve() as an explicit (strict) request at trace time,
+            # and a cached choice the host cannot serve (shared tune
+            # cache, probe change) must degrade to auto instead of
+            # crashing a user who never asked for a backend
+            backend = tuned.get("backend")
+            if backend:
+                from ..kernels import resolve as _kresolve
+
+                try:
+                    _kresolve("flash_attention", backend)
+                except Exception:  # unavailable/unknown tuned choice
+                    backend = None
             if tuned.get("diag_w"):
                 # the winner was MEASURED at this sub-tile width; the
                 # kernels read the module global at trace time
@@ -1019,14 +1048,16 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
         # the 4-D path — RESULTS.md round 4/5)
         ctx = flash_attention_packed(q, k, v, n_head, causal=causal,
                                      sm_scale=1.0 / float(dh) ** 0.5,
-                                     block_q=block_q, block_k=block_k)
+                                     block_q=block_q, block_k=block_k,
+                                     backend=backend)
     else:
         qh = reshape(q, [b, tq, n_head, dh])
         kh = reshape(k, [b, tk, n_head, dh])
         vh = reshape(v, [b, tk, n_head, dh])
         ctx = flash_attention(qh, kh, vh, causal=causal,
                               sm_scale=1.0 / float(dh) ** 0.5,
-                              block_q=block_q, block_k=block_k)
+                              block_q=block_q, block_k=block_k,
+                              backend=backend)
         ctx = reshape(ctx, [b, tq, d_model])
     out = fc(ctx, d_model, num_flatten_dims=2, param_attr=_proj_attr("out"),
              name=None if name is None else name + "_out")

@@ -386,8 +386,18 @@ def program_workload_key(program, remat=None):
             platform = "unknown"
         pol = remat if remat is not None else (
             getattr(program, "_remat_policy", None) or "-")
+        try:
+            # which kernel backend the flash op class resolved to at
+            # THIS compile's trace (kernels/registry.py) — the |kb=
+            # token that keys corpus rows / bench rows / trainer JSONL
+            # by which kernel ran, not just the platform
+            from ..kernels import selected_backends
+
+            kb = selected_backends().get("flash_attention")
+        except Exception:  # kernels package unavailable mid-bootstrap
+            kb = None
         return WorkloadKey("step", t, d_head, n_head, var.dtype,
-                           platform, remat=pol).s
+                           platform, remat=pol, backend=kb).s
     return None
 
 

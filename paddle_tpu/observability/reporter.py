@@ -175,6 +175,13 @@ class MetricsReporter:
                 attr_coverage=att.get("coverage"),
                 attr_workload=att.get("workload"),
                 attr_model_err_pct=attr_err,
+                # which kernel-registry backend each op class of the
+                # compiled step resolved to (docs/kernels.md) — the
+                # attr_workload |kb= token carries the flash choice;
+                # this field carries the full per-op-class map so
+                # bench-history/corpus tooling can segment trajectories
+                # by backend
+                kernel_backends=sc.get("kernel_backends"),
             )
         if self.log_every_n and ev.batch_id % self.log_every_n == 0:
             self._print(self._summary_line(ev, wall, throughput, mfu_v,

@@ -979,12 +979,25 @@ def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, n_head,
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=1024,
-                    block_k=1024, interpret=None):
-    """Fused attention.  q [b, t_q, h, d], k/v [b, t_k, h, d] ->
-    [b, t_q, h, d].  Differentiable (custom VJP).  ``interpret=None``
-    auto-selects Pallas interpreter mode off-TPU so the same code path runs
-    in CPU tests."""
+def _resolve_backend(backend):
+    """One selection path for every flash entry point: the kernel
+    registry's resolution (explicit arg > per-op env > global env >
+    auto; docs/kernels.md).  Returns ``(name, impl)``.  The old ad-hoc
+    per-platform fallback — ``interpret = jax.default_backend() !=
+    "tpu"`` at each call site — is now the ``pallas_tpu`` backend's own
+    interpret default behind this path."""
+    from ..kernels import resolve  # late: kernels imports this module
+
+    kernel = resolve("flash_attention", backend)
+    return kernel.backend, kernel.impl
+
+
+def _pallas_flash_attention(q, k, v, causal=False, sm_scale=None,
+                            block_q=1024, block_k=1024, interpret=None):
+    """The Mosaic (``pallas_tpu``) flash attention: q [b, t_q, h, d],
+    k/v [b, t_k, h, d] -> [b, t_q, h, d].  Differentiable (custom VJP).
+    ``interpret=None`` auto-selects Pallas interpreter mode off-TPU so
+    the same kernel logic runs in CPU tests."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, t_q, h, d = q.shape
@@ -1000,6 +1013,25 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=1024,
         bool(interpret), None,
     )
     return jnp.swapaxes(o.reshape(b, h, t_q, d), 1, 2)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=1024,
+                    block_k=1024, interpret=None, backend=None):
+    """Fused attention, routed through the kernel registry
+    (docs/kernels.md): ``backend`` picks pallas_tpu | triton | xla_ref
+    explicitly, None resolves env overrides then the platform's auto
+    order.  q [b, t_q, h, d], k/v [b, t_k, h, d] -> [b, t_q, h, d];
+    differentiable through every backend (each carries the same
+    custom-VJP residual contract)."""
+    name, impl = _resolve_backend(backend)
+    if name == "pallas_tpu":
+        return _pallas_flash_attention(q, k, v, causal=causal,
+                                       sm_scale=sm_scale, block_q=block_q,
+                                       block_k=block_k,
+                                       interpret=interpret)
+    return impl.call(q, k, v, causal=causal, sm_scale=sm_scale,
+                     block_q=block_q, block_k=block_k,
+                     interpret=interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -1033,11 +1065,26 @@ _flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
 
 
 def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
-                             block_q=1024, block_k=1024, interpret=None):
+                             block_q=1024, block_k=1024, interpret=None,
+                             backend=None):
     """flash_attention that ALSO returns the per-row logsumexp
     (o [b, t, h, d], lse [b, h, t]) — the building block for composing
     partial attentions with online-softmax merges (ring attention).
-    Fully differentiable including through lse."""
+    Fully differentiable including through lse; registry-routed like
+    ``flash_attention``."""
+    name, impl = _resolve_backend(backend)
+    if name != "pallas_tpu":
+        return impl.call_with_lse(q, k, v, causal=causal,
+                                  sm_scale=sm_scale, block_q=block_q,
+                                  block_k=block_k, interpret=interpret)
+    return _pallas_flash_attention_with_lse(
+        q, k, v, causal=causal, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, interpret=interpret)
+
+
+def _pallas_flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
+                                     block_q=1024, block_k=1024,
+                                     interpret=None):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, t_q, h, d = q.shape
@@ -1058,7 +1105,8 @@ def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
 
 
 def flash_attention_packed(q, k, v, n_head, causal=False, sm_scale=None,
-                           block_q=1024, block_k=1024, interpret=None):
+                           block_q=1024, block_k=1024, interpret=None,
+                           backend=None):
     """Fused attention on the RAW projection layout: q/k/v [b, t, h*d]
     (heads concatenated in the feature dim, exactly what the QKV matmuls
     emit) -> o [b, t, h*d] (exactly what the output projection consumes).
@@ -1072,7 +1120,22 @@ def flash_attention_packed(q, k, v, n_head, causal=False, sm_scale=None,
     heads per slice — the kernels run two independent softmax states over
     the 64-lane halves, so d_head-64 models dodge the transpose tax too),
     or ``n_head == 1``.  Other widths raise; callers use
-    ``flash_attention``."""
+    ``flash_attention``.  Registry-routed: the triton/xla_ref backends
+    are shape-complete here (their head split is a reshape, not a
+    Mosaic lane slice), so every head width works off the TPU path."""
+    name, impl = _resolve_backend(backend)
+    if name != "pallas_tpu":
+        return impl.call_packed(q, k, v, n_head, causal=causal,
+                                sm_scale=sm_scale, block_q=block_q,
+                                block_k=block_k, interpret=interpret)
+    return _pallas_flash_attention_packed(
+        q, k, v, n_head, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+def _pallas_flash_attention_packed(q, k, v, n_head, causal=False,
+                                   sm_scale=None, block_q=1024,
+                                   block_k=1024, interpret=None):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, t_q, hd = q.shape
@@ -1113,11 +1176,12 @@ from ..core.registry import register_op
 
 @register_op("flash_attention")
 def flash_attention_op(Q, K, V, causal=False, sm_scale=0.0, block_q=1024,
-                       block_k=1024, **_):
+                       block_k=1024, backend="", **_):
     scale = None if not sm_scale else float(sm_scale)
     return {"Out": flash_attention(Q, K, V, causal=causal, sm_scale=scale,
                                    block_q=int(block_q),
-                                   block_k=int(block_k))}
+                                   block_k=int(block_k),
+                                   backend=backend or None)}
 
 
 def _tp_axis(_ctx):
@@ -1132,7 +1196,7 @@ def _tp_axis(_ctx):
 @register_op("flash_attention_packed")
 def flash_attention_packed_op(Q, K, V, n_head=None, causal=False,
                               sm_scale=0.0, block_q=1024, block_k=1024,
-                              _ctx=None, **_):
+                              backend="", _ctx=None, **_):
     if n_head is None:
         # no safe default: 1 would silently softmax across the whole
         # concatenated h*d feature dim as a single head
@@ -1140,6 +1204,7 @@ def flash_attention_packed_op(Q, K, V, n_head=None, causal=False,
     n_head = int(n_head)
     block_q, block_k = int(block_q), int(block_k)
     scale = None if not sm_scale else float(sm_scale)
+    backend = backend or None
     mesh, tp = _tp_axis(_ctx)
     if tp > 1 and n_head % tp == 0:
         # Head-sharded tensor parallelism: the packed feature dim IS the
@@ -1167,15 +1232,34 @@ def flash_attention_packed_op(Q, K, V, n_head=None, causal=False,
                 r4 = lambda x: x.reshape(b, t, local_heads, d_head)
                 o = flash_attention(
                     r4(q), r4(k), r4(v), causal=causal, sm_scale=scale,
-                    block_q=block_q, block_k=block_k)
+                    block_q=block_q, block_k=block_k, backend=backend)
                 return o.reshape(b, t, hd)
             return flash_attention_packed(
                 q, k, v, local_heads, causal=causal, sm_scale=scale,
-                block_q=block_q, block_k=block_k)
+                block_q=block_q, block_k=block_k, backend=backend)
 
         out = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                         out_specs=spec, check_rep=False)(Q, K, V)
         return {"Out": out}
     return {"Out": flash_attention_packed(
         Q, K, V, n_head, causal=causal, sm_scale=scale,
-        block_q=block_q, block_k=block_k)}
+        block_q=block_q, block_k=block_k, backend=backend)}
+
+
+# -- kernel-registry registration (docs/kernels.md) --------------------------
+# The Mosaic kernels above ARE the "pallas_tpu" backend of the
+# flash_attention op class: native on TPU, interpret mode off-TPU (the
+# CPU test path — the availability reason annotates it).
+from ..kernels.registry import (
+    pallas_tpu_availability as _pallas_tpu_availability,
+    register_kernel as _register_kernel)
+
+
+class _FlashPallasTpu:
+    call = staticmethod(_pallas_flash_attention)
+    call_with_lse = staticmethod(_pallas_flash_attention_with_lse)
+    call_packed = staticmethod(_pallas_flash_attention_packed)
+
+
+_register_kernel("flash_attention", "pallas_tpu", _FlashPallasTpu,
+                 available=_pallas_tpu_availability)

@@ -283,20 +283,37 @@ def _ce_core_lse_bwd(blocks, interpret, res, cts):
 _ce_core_lse.defvjp(_ce_core_lse_fwd, _ce_core_lse_bwd)
 
 
+def _resolve_backend(backend):
+    """One selection path (the kernel registry, docs/kernels.md) for
+    both CE entry points — replaces the per-call-site
+    ``interpret = jax.default_backend() != "tpu"`` fallback."""
+    from ..kernels import resolve  # late: kernels imports this module
+
+    kernel = resolve("fused_ce", backend)
+    return kernel.backend, kernel.impl
+
+
 def fused_softmax_ce_head_with_lse(x, w, labels, block_n=512,
                                    block_v=1024, interpret=None,
-                                   block_v_fwd=2048):
+                                   block_v_fwd=2048, backend=None):
     """``fused_softmax_ce_head`` that ALSO returns the per-position lse
     (both ``[...]`` f32), differentiable through both — callers compose
     partial losses across vocab shards with a logsumexp merge
     (parallelism: see the fused_softmax_ce_head op's tp path)."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     lead = x.shape[:-1]
     d = x.shape[-1]
     n = 1
     for s in lead:
         n *= int(s)
+    name, impl = _resolve_backend(backend)
+    if name != "pallas_tpu":
+        loss, lse = impl.call_with_lse(
+            x.reshape(n, d), w, labels.reshape(n), block_n=block_n,
+            block_v=block_v, block_v_fwd=block_v_fwd,
+            interpret=interpret)
+        return loss.reshape(lead), lse.reshape(lead)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     bn, bv, bv_fwd = _auto_blocks(
         n, d, w.shape[1], x.dtype.itemsize, w.dtype.itemsize,
         int(block_n), int(block_v), int(block_v_fwd))
@@ -365,12 +382,15 @@ def _auto_blocks(n, d, v, ix, iw, block_n, block_v, block_v_fwd,
 
 
 def fused_softmax_ce_head(x, w, labels, block_n=512, block_v=1024,
-                          interpret=None, block_v_fwd=2048):
+                          interpret=None, block_v_fwd=2048, backend=None):
     """Fused projection + softmax cross-entropy: ``x [..., d]``,
     ``w [d, v]``, ``labels [...]`` int -> per-position NLL ``[...]`` f32,
-    without ever materializing ``[..., v]`` logits in HBM.
-    Differentiable in x and w (custom VJP).  ``interpret=None``
-    auto-selects Pallas interpreter mode off-TPU (CPU tests).
+    without ever materializing ``[..., v]`` logits in HBM (the xla_ref
+    oracle backend materializes them — that is its point).
+    Differentiable in x and w (custom VJP in every backend); routed
+    through the kernel registry (docs/kernels.md) — ``backend`` picks
+    pallas_tpu | triton | xla_ref explicitly, None resolves env
+    overrides then the platform auto order.
 
     Block args are UPPER bounds: the chooser shrinks them per kernel to
     fit scoped VMEM (the forward fits a wider vocab block than the
@@ -378,13 +398,19 @@ def fused_softmax_ce_head(x, w, labels, block_n=512, block_v=1024,
     bv=2048/d=768; measured fwd 10.8 -> 9.7 ms at the flagship shape
     with the split sizes), so d_model >= 1024 configs work instead of
     hitting a raw Mosaic VMEM error."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     lead = x.shape[:-1]
     d = x.shape[-1]
     n = 1
     for s in lead:
         n *= int(s)
+    name, impl = _resolve_backend(backend)
+    if name != "pallas_tpu":
+        loss = impl.call(x.reshape(n, d), w, labels.reshape(n),
+                         block_n=block_n, block_v=block_v,
+                         block_v_fwd=block_v_fwd, interpret=interpret)
+        return loss.reshape(lead)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     bn, bv, bv_fwd = _auto_blocks(
         n, d, w.shape[1], x.dtype.itemsize, w.dtype.itemsize,
         int(block_n), int(block_v), int(block_v_fwd))
@@ -405,7 +431,9 @@ def fused_softmax_ce_head_reference(x, w, labels):
 
 @register_op("fused_softmax_ce_head")
 def fused_softmax_ce_head_op(X, W, Label, block_n=512, block_v=1024,
-                             block_v_fwd=2048, _ctx=None, **_):
+                             block_v_fwd=2048, backend="", _ctx=None,
+                             **_):
+    backend = backend or None
     lbl = Label
     if lbl.ndim == X.ndim and lbl.shape[-1] == 1:
         lbl = lbl.reshape(lbl.shape[:-1])
@@ -438,7 +466,7 @@ def fused_softmax_ce_head_op(X, W, Label, block_n=512, block_v=1024,
             y_loc = jnp.clip(y - off, 0, vs - 1)
             loss_s, lse_s = fused_softmax_ce_head_with_lse(
                 x, w, y_loc, block_n=block_n, block_v=block_v,
-                block_v_fwd=block_v_fwd)
+                block_v_fwd=block_v_fwd, backend=backend)
             picked = jnp.where(in_s, lse_s - loss_s, 0.0)
             # the max shift is numerical stabilization only (it cancels
             # algebraically) — stop_gradient keeps the merge on psum's
@@ -454,5 +482,49 @@ def fused_softmax_ce_head_op(X, W, Label, block_n=512, block_v=1024,
         return {"Loss": loss[..., None]}
     loss = fused_softmax_ce_head(X, W, lbl, block_n=block_n,
                                  block_v=block_v,
-                                 block_v_fwd=block_v_fwd)
+                                 block_v_fwd=block_v_fwd,
+                                 backend=backend)
     return {"Loss": loss[..., None]}
+
+
+# -- kernel-registry registration (docs/kernels.md) --------------------------
+# The Mosaic kernels above ARE the "pallas_tpu" backend of the fused_ce
+# op class; impl convention is 2-D (x [n, d], w [d, v], labels [n]).
+from ..kernels.registry import (
+    pallas_tpu_availability as _pallas_tpu_availability,
+    register_kernel as _register_kernel)
+
+
+def _pallas_ce(x, w, labels, block_n=None, block_v=None,
+               block_v_fwd=None, interpret=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, d = x.shape
+    bn, bv, bv_fwd = _auto_blocks(
+        n, d, w.shape[1], x.dtype.itemsize, w.dtype.itemsize,
+        int(block_n or 512), int(block_v or 1024),
+        int(block_v_fwd or 2048))
+    return _ce_core(x, w, labels.astype(jnp.int32), (bn, bv, bv_fwd),
+                    bool(interpret))
+
+
+def _pallas_ce_with_lse(x, w, labels, block_n=None, block_v=None,
+                        block_v_fwd=None, interpret=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, d = x.shape
+    bn, bv, bv_fwd = _auto_blocks(
+        n, d, w.shape[1], x.dtype.itemsize, w.dtype.itemsize,
+        int(block_n or 512), int(block_v or 1024),
+        int(block_v_fwd or 2048))
+    return _ce_core_lse(x, w, labels.astype(jnp.int32),
+                        (bn, bv, bv_fwd), bool(interpret))
+
+
+class _CePallasTpu:
+    call = staticmethod(_pallas_ce)
+    call_with_lse = staticmethod(_pallas_ce_with_lse)
+
+
+_register_kernel("fused_ce", "pallas_tpu", _CePallasTpu,
+                 available=_pallas_tpu_availability)

@@ -51,6 +51,16 @@ import jax.numpy as jnp
 __all__ = ["paged_step_logits", "make_decode_chunk", "make_prefill"]
 
 
+def _gather_kv(pool, table):
+    """The block-table gather, routed through the kernel registry
+    (``decode_gather`` op class, docs/kernels.md): the XLA
+    advanced-indexing gather off-TPU, the scalar-prefetch Pallas kernel
+    on TPU.  Bit-exact across backends — a gather moves bits."""
+    from ..kernels import resolve
+
+    return resolve("decode_gather").impl.call(pool, table)
+
+
 def _ln(x, scale, bias, eps):
     # statistics in f32 even under bf16 compute (mean/var cancellation) —
     # mirrors transformer.generate's ln exactly
@@ -103,8 +113,9 @@ def paged_step_logits(p, tok, t, pool_k, pool_v, table, n_layer, n_head,
         pk_out.append(pk)
         pv_out.append(pv)
         # gather each slot's logical sequence view [S, T, h, dh]
-        ck = pk[table].reshape(S, T, n_head, dh)
-        cv = pv[table].reshape(S, T, n_head, dh)
+        # through the registry-routed decode_gather kernel
+        ck = _gather_kv(pk, table)
+        cv = _gather_kv(pv, table)
         s = jnp.einsum("shd,sThd->shT", qh, ck,
                        preferred_element_type=jnp.float32)
         s = s / jnp.sqrt(float(dh))

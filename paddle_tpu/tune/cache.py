@@ -81,8 +81,30 @@ def geometry_fingerprint():
         tuple(pa._pick_block(t, c)
               for t in (96, 2048, 4096, 16384)
               for c in (128, 256, 512, 1024, 2048)),
+        _registry_surface(),
     )
     return hashlib.sha256(repr(basis).encode()).hexdigest()[:12]
+
+
+def _registry_surface():
+    """The kernel-registry decision surface (docs/kernels.md): which
+    backends each op class registers and the per-platform auto order.
+    A tuned winner persists its kernel choice, so adding/removing a
+    backend or reordering auto resolution changes what a cached config
+    MEANS — the fingerprint must move with it.  Availability is
+    deliberately NOT hashed: it is a host property, not a geometry
+    decision (the workload key's ``plat=`` field already scopes it)."""
+    try:
+        from .. import kernels
+    except Exception:  # mid-bootstrap partial import
+        return ()
+    return (
+        tuple((op, tuple(sorted(b for b in kernels.BACKENDS
+                                if kernels.get_kernel(op, b))))
+              for op in kernels.registered_op_classes()),
+        tuple(sorted((plat, order)
+                     for plat, order in kernels.AUTO_ORDER.items())),
+    )
 
 
 def _git_sha():

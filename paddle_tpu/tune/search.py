@@ -86,6 +86,10 @@ def _measure_candidate(cand, *, seq_len, n_layer, d_model, n_head, vocab,
     from paddle_tpu.analysis import preflight_hbm
     from paddle_tpu.models import transformer
 
+    import contextlib
+
+    from ..kernels import forced_backend
+
     pt.core.unique_name.reset()
     main_prog, startup = pt.Program(), pt.Program()
     main_prog.random_seed = 11
@@ -118,40 +122,74 @@ def _measure_candidate(cand, *, seq_len, n_layer, d_model, n_head, vocab,
     pt.core.scope._scope_stack.append(scope)
     try:
         exe = pt.Executor()
-        exe.run(startup, scope=scope)
-        with _diag_w(cand.get("diag_w")):
-            cost = exe.compile_only(main_prog, feed=feed,
-                                    fetch_list=[outs["avg_cost"]],
-                                    scope=scope)
-            findings = preflight_hbm(cost.get("hbm_high_water_bytes"),
-                                     budget_bytes,
-                                     context=f"candidate {cand}")
-            if findings:
-                raise PreflightRejected(findings[0].message)
-            run = lambda: exe.run(main_prog, feed=feed,
-                                  fetch_list=[outs["avg_cost"]],
-                                  scope=scope, return_numpy=False)
-            for _ in range(max(0, warmup)):
-                run()
-            times = []
-            for _ in range(max(1, repeats)):
-                t0 = time.perf_counter()
-                out = None
-                for _ in range(max(1, steps)):
-                    out = run()
-                np.asarray(out[0])  # host materialization = honest stop
-                times.append(time.perf_counter() - t0)
+        # the candidate's kernel-registry backend (docs/kernels.md):
+        # forced for the whole compile/measure phase — kernel
+        # resolution happens at TRACE time inside these runs (program
+        # BUILD resolves nothing), so one context around them routes
+        # every op of the step (flash AND the CE head) to the backend
+        # being measured; an op the backend cannot serve falls back to
+        # auto, exactly what the shipped configuration would do
+        backend_ctx = (forced_backend(cand["backend"])
+                       if cand.get("backend")
+                       else contextlib.nullcontext())
+        with backend_ctx:
+            exe.run(startup, scope=scope)
+            with _diag_w(cand.get("diag_w")):
+                cost = exe.compile_only(main_prog, feed=feed,
+                                        fetch_list=[outs["avg_cost"]],
+                                        scope=scope)
+                findings = preflight_hbm(cost.get("hbm_high_water_bytes"),
+                                         budget_bytes,
+                                         context=f"candidate {cand}")
+                if findings:
+                    raise PreflightRejected(findings[0].message)
+                run = lambda: exe.run(main_prog, feed=feed,
+                                      fetch_list=[outs["avg_cost"]],
+                                      scope=scope, return_numpy=False)
+                for _ in range(max(0, warmup)):
+                    run()
+                times = []
+                for _ in range(max(1, repeats)):
+                    t0 = time.perf_counter()
+                    out = None
+                    for _ in range(max(1, steps)):
+                        out = run()
+                    np.asarray(out[0])  # host materialization = honest stop
+                    times.append(time.perf_counter() - t0)
     finally:
         pt.core.scope._scope_stack.pop()
     return float(np.median(times)), cost
+
+
+def _truncate_survivors(survivors, max_measure, report):
+    """Cap the measured-candidate list at ``max_measure`` WITHOUT
+    silently dropping a whole kernel backend: geometry-free backend
+    candidates carry no roofline score, so a plain head-slice of the
+    sorted list would cut e.g. the only xla_ref candidate and the
+    "tuner picks kernels" dimension would degenerate to the pre-registry
+    search with no trace.  The head keeps the statically best schedules;
+    one best-ranked candidate per otherwise-dropped backend rides along
+    (the budget stretches by at most the number of requested
+    backends)."""
+    if not max_measure or len(survivors) <= max_measure:
+        return survivors
+    keep = survivors[:max_measure]
+    kept_backends = {c.get("backend") for c in keep}
+    for c in survivors[max_measure:]:
+        b = c.get("backend")
+        if b is not None and b not in kept_backends:
+            keep.append(c)
+            kept_backends.add(b)
+    report["truncated_to"] = len(keep)
+    return keep
 
 
 def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
                   dtype="bfloat16", fused_head=True, steps=2, warmup=1,
                   repeats=3, budget_bytes=None, block_caps=None,
                   policies=POLICY_ORDER, accums=(1,), diag_ws=(256,),
-                  fsdp_opts=(None,), max_measure=8, learning_rate=1e-3,
-                  force=False, mode=None):
+                  fsdp_opts=(None,), backends=None, max_measure=8,
+                  learning_rate=1e-3, force=False, mode=None):
     """Search (or serve from cache) the step schedule for one GPT shape.
 
     Returns a report dict: ``entry`` (the winning cache entry or None),
@@ -199,7 +237,7 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
     cands = schedule_candidates(seq_len, d_model // n_head, n_head,
                                 block_caps=block_caps, policies=policies,
                                 accums=accums or (1,), diag_ws=diag_ws,
-                                fsdp_opts=fsdp_opts)
+                                fsdp_opts=fsdp_opts, backends=backends)
     report["candidates"] = len(cands)
     hbm_model = lambda c: estimate_gpt_step_hbm(
         n_layer, d_model, n_head, vocab, seq_len, batch,
@@ -220,9 +258,7 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
     survivors.sort(key=lambda c: (
         POLICY_ORDER.index(c.get("policy") or "none"),
         c.get("accum", 1), c.get("roofline", 9.9), -c["block_q"]))
-    if max_measure and len(survivors) > max_measure:
-        report["truncated_to"] = max_measure
-        survivors = survivors[:max_measure]
+    survivors = _truncate_survivors(survivors, max_measure, report)
 
     tracer = _trace.get_tracer()
     measured = []
@@ -261,6 +297,17 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
                        temp_bytes=cost.get("temp_bytes"),
                        compile_seconds=round(
                            cost.get("compile_seconds") or 0.0, 3))
+            # persist the backend that ACTUALLY ran, not the request:
+            # forced_backend is non-strict, so an unavailable backend
+            # candidate measures the auto fallback — recording the
+            # requested name would cache a kernel choice that never
+            # executed (the "keyed by which kernel ran" contract,
+            # docs/kernels.md)
+            kb = (cost.get("kernel_backends") or {}).get(
+                "flash_attention")
+            if cand.get("backend") and kb and kb != cand["backend"]:
+                rec["backend"] = kb
+                rec["backend_requested"] = cand["backend"]
             measured.append(rec)
             sp.set(verdict="measured", median_s=rec["median_s"])
     report["measured"] = measured
@@ -270,8 +317,9 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
         return report
     win = min(timed, key=lambda m: m["median_s"])
     config = {k: win[k] for k in ("block_q", "block_k", "diag_w",
-                                  "packed", "policy", "accum", "fsdp")
-              if k in win}
+                                  "packed", "policy", "accum", "fsdp",
+                                  "backend")
+              if k in win and win[k] is not None}
     meas = {k: win[k] for k in ("median_s", "tok_s", "flops",
                                 "bytes_accessed", "hbm_high_water_bytes",
                                 "temp_bytes") if win.get(k) is not None}
@@ -285,7 +333,8 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
                             dtype, key.platform, remat="-")
     cache.put(flash_key.s,
               {k: config[k] for k in ("block_q", "block_k", "diag_w",
-                                      "packed") if k in config},
+                                      "packed", "backend")
+               if k in config},
               measured={"from": key.s})
     cache.save()
     tracer.instant("tune.winner", cat="tune", key=key.s, **config)

@@ -69,17 +69,21 @@ def _canon_dtype(dtype):
 
 class WorkloadKey:
     """The identity a tuned config is valid for:
-    ``(op, seq_len, d_head, n_heads, dtype, platform, remat)``.
+    ``(op, seq_len, d_head, n_heads, dtype, platform, remat[, backend])``.
     ``remat`` is the POLICY DIMENSION marker: concrete kernel keys pin
     the policy they were measured under; schedule keys (where the policy
-    itself is tuned) use ``"auto"``.  ``.s`` is the canonical string the
-    cache files key on."""
+    itself is tuned) use ``"auto"``.  ``backend`` is the kernel-registry
+    backend the workload RAN on (docs/kernels.md) — appended as a
+    ``|kb=`` token only when known, so pre-registry keys stay stable
+    (the tuner treats the backend like the policy: a searchable config
+    dimension, with the RESOLVED choice recorded on attribution/corpus
+    keys).  ``.s`` is the canonical string the cache files key on."""
 
     __slots__ = ("op", "seq_len", "d_head", "n_heads", "dtype",
-                 "platform", "remat")
+                 "platform", "remat", "backend")
 
     def __init__(self, op, seq_len, d_head, n_heads, dtype,
-                 platform, remat="auto"):
+                 platform, remat="auto", backend=None):
         self.op = str(op)
         self.seq_len = int(seq_len)
         self.d_head = int(d_head)
@@ -87,12 +91,16 @@ class WorkloadKey:
         self.dtype = _canon_dtype(dtype)
         self.platform = str(platform)
         self.remat = str(remat)
+        self.backend = None if backend is None else str(backend)
 
     @property
     def s(self):
-        return (f"op={self.op}|t={self.seq_len}|dh={self.d_head}"
+        base = (f"op={self.op}|t={self.seq_len}|dh={self.d_head}"
                 f"|h={self.n_heads}|dt={self.dtype}|plat={self.platform}"
                 f"|remat={self.remat}")
+        if self.backend:
+            base += f"|kb={self.backend}"
+        return base
 
     def __repr__(self):
         return f"WorkloadKey({self.s})"
@@ -113,39 +121,86 @@ def _block_choices(seq_len, caps=None):
 
 
 def attention_candidates(seq_len, d_head, n_head, block_caps=None,
-                         diag_ws=(128, 256), include_packed=True):
+                         diag_ws=(128, 256), include_packed=True,
+                         backends=None):
     """The flash kernel-geometry candidate list for one shape:
-    ``{"block_q", "block_k", "diag_w", "packed"}`` dicts."""
+    ``{"block_q", "block_k", "diag_w", "packed"}`` dicts.
+
+    ``backends`` adds the kernel-registry choice as a SEARCHABLE
+    dimension (docs/kernels.md): each name in the tuple yields
+    candidates carrying ``"backend"``.  Block/diag geometry only means
+    anything to the Pallas-schedule backends — ``xla_ref`` (and any
+    backend that owns its own tiling) contributes ONE candidate with
+    the backend alone, so the cross product never multiplies compiles
+    for knobs the backend ignores.  ``None`` (default) keeps the
+    pre-registry candidate list: no ``"backend"`` key, resolution left
+    to env/auto."""
     packs = [None]
     if include_packed and packed_sub_heads(n_head, d_head) is not None:
         # the packed layout is the measured win (no head transposes) but
         # the 4-D spelling is a legal schedule — let measurement decide
         packs = [True, False]
-    out = []
+    geo = []
     for bq in _block_choices(seq_len, block_caps):
         for bk in _block_choices(seq_len, block_caps):
             for w in sorted({_pick_block(min(bq, bk), int(dw))
                              for dw in diag_ws}):
                 for p in packs:
-                    out.append({"block_q": bq, "block_k": bk,
+                    geo.append({"block_q": bq, "block_k": bk,
                                 "diag_w": w, "packed": p})
+    if not backends:
+        return geo
+    out = []
+    for b in backends:
+        if b == "pallas_tpu":
+            out.extend(dict(g, backend=str(b)) for g in geo)
+        elif b == "triton":
+            # the triton lowering clamps blocks to its MAX_BLOCK=128
+            # SRAM tiles and ignores diag_w/packed (it masks every
+            # visited block; packed is a reshape) — candidates above
+            # the clamp would be measured as DUPLICATE kernels and
+            # VMEM-scored for tiles they never allocate, so the
+            # geometry cross is generated at the clamped caps and
+            # deduped
+            caps = tuple(min(int(c), 128)
+                         for c in (block_caps or (256, 512, 1024, 2048)))
+            seen = set()
+            for bq in _block_choices(seq_len, caps):
+                for bk in _block_choices(seq_len, caps):
+                    if (bq, bk) in seen:
+                        continue
+                    seen.add((bq, bk))
+                    out.append({"block_q": bq, "block_k": bk,
+                                "diag_w": None, "packed": None,
+                                "backend": "triton"})
+        else:
+            # geometry-free backend: one candidate, default blocks so
+            # downstream consumers (program build) still have values
+            out.append({"block_q": _pick_block(seq_len, 1024),
+                        "block_k": _pick_block(seq_len, 1024),
+                        "diag_w": None, "packed": None,
+                        "backend": str(b)})
     return out
 
 
 def schedule_candidates(seq_len, d_head, n_head, block_caps=None,
                         policies=POLICY_ORDER, accums=(1, 2),
-                        diag_ws=(256,), fsdp_opts=(None,)):
+                        diag_ws=(256,), fsdp_opts=(None,),
+                        backends=None):
     """The step-schedule candidate list: kernel geometry x remat policy
     x gradient-accumulation factor (x FSDP gather-vs-replicate when the
     caller is tuning a mesh with an ``fsdp`` axis: ``fsdp_opts=(False,
     True)`` adds the dimension — TVM-style, the schedule decision stays
     inside the measured search instead of hardcoded; ``None`` entries
-    leave the key off the candidate, the single-chip default)."""
+    leave the key off the candidate, the single-chip default; x the
+    kernel-registry ``backends`` when given — the autotuner picks
+    KERNELS, not just block shapes, docs/kernels.md)."""
     out = []
     for geo in attention_candidates(seq_len, d_head, n_head,
                                     block_caps=block_caps,
                                     diag_ws=diag_ws,
-                                    include_packed=False):
+                                    include_packed=False,
+                                    backends=backends):
         for pol in policies:
             for acc in accums:
                 for fs in fsdp_opts:
@@ -240,8 +295,23 @@ def prune_static(seq_len, d_head, n_head, candidates, dtype_size=2,
       callable are given, candidates whose analytic bound exceeds the
       budget are rejected — the BENCH_r05 class dies here, from
       arithmetic alone, before any compile."""
-    scored, pruned = [], []
+    scored, pruned, passthrough = [], [], []
     for c in candidates:
+        if c.get("backend") not in (None, "pallas_tpu", "triton"):
+            # geometry-free backend candidate (xla_ref): the VMEM and
+            # block-schedule roofline models describe the Pallas
+            # schedules, not XLA's own tiling — only the HBM bound
+            # applies; measurement settles the rest
+            if hbm_budget and hbm_model is not None:
+                est = hbm_model(c)
+                if est > hbm_budget:
+                    pruned.append(
+                        (c, f"hbm estimate {est / (1 << 30):.1f} GiB > "
+                            f"budget {hbm_budget / (1 << 30):.1f} GiB"))
+                    continue
+                c = dict(c, hbm_est_bytes=int(est))
+            passthrough.append(c)
+            continue
         if seq_len % c["block_q"] or seq_len % c["block_k"]:
             pruned.append((c, "blocks do not tile t"))
             continue
@@ -256,9 +326,9 @@ def prune_static(seq_len, d_head, n_head, candidates, dtype_size=2,
         c = dict(c, roofline=round(sched / max(useful, 1), 4))
         scored.append((sched, c))
     if not scored:
-        return [], pruned
+        return passthrough, pruned
     best = min(s for s, _ in scored)
-    survivors = []
+    survivors = list(passthrough)
     for sched, c in scored:
         if sched > best * roofline_slack:
             pruned.append(

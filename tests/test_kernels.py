@@ -1,0 +1,480 @@
+"""Multi-backend kernel registry: the oracle suite + the registry unit
+suite (docs/kernels.md).
+
+Oracle contract: every registered backend AVAILABLE on this host is
+compared against the ``xla_ref`` reference within the documented
+``ORACLE_TOL`` bounds (f32 + bf16, causal + non-causal, d_head 64/128,
+grads through the custom-vjp); unavailable backends SKIP with the
+registry's reason.  The GPU (triton) kernels additionally run
+interpret-forced so their logic is covered on CPU-only CI.  Within a
+backend the contract is bit-exact run-to-run.
+
+Registry contract: precedence explicit arg > per-op env > global env >
+auto; unknown backends raise ValueError; explicitly requested
+unavailable backends raise KernelUnavailable with a reason; a global
+env pin an op cannot serve degrades to auto.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import kernels  # noqa: E402
+from paddle_tpu.kernels import (  # noqa: E402
+    KernelUnavailable, available_backends, forced_backend, get_kernel,
+    oracle_tol, resolve_name)
+
+
+def _rel_err(a, ref):
+    a = jnp.asarray(a, jnp.float32)
+    ref = jnp.asarray(ref, jnp.float32)
+    scale = float(jnp.max(jnp.abs(ref))) or 1.0
+    return float(jnp.max(jnp.abs(a - ref))) / scale
+
+
+def _impl_or_skip(op, backend):
+    rows = {b: (ok, reason) for b, ok, reason in available_backends(op)}
+    if backend not in rows:
+        pytest.skip(f"{backend} not registered for {op}")
+    ok, reason = rows[backend]
+    if not ok:
+        pytest.skip(f"{backend} unavailable: {reason}")
+    return get_kernel(op, backend).impl
+
+
+def _qkv(dt, d, b=1, t=128, h=2, seed=5):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5, dt)
+                 for _ in range(3))
+
+
+# -- oracle suite ------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", kernels.BACKENDS)
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("d_head", [64, 128])
+def test_flash_oracle_parity(backend, dtype, causal, d_head):
+    impl = _impl_or_skip("flash_attention", backend)
+    oracle = get_kernel("flash_attention", "xla_ref").impl
+    q, k, v = _qkv(jnp.dtype(dtype), d_head)
+    # explicit 64-wide blocks: t=128 then tiles 2x2, so the online-
+    # softmax state actually carries across k blocks and causal cells
+    # straddle the diagonal — default (1024-capped) blocks would make
+    # this a degenerate single-block kernel
+    got = impl.call(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = oracle.call(q, k, v, causal=causal)
+    assert _rel_err(got, ref) <= oracle_tol(
+        "flash_attention", dtype, "fwd")
+
+
+@pytest.mark.parametrize("backend", kernels.BACKENDS)
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_flash_oracle_grads_through_custom_vjp(backend, dtype):
+    impl = _impl_or_skip("flash_attention", backend)
+    oracle = get_kernel("flash_attention", "xla_ref").impl
+    q, k, v = _qkv(jnp.dtype(dtype), 64, b=1)
+    wgt = jnp.asarray(np.random.default_rng(7).normal(size=q.shape),
+                      jnp.float32)
+
+    def loss(fn, **kw):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, causal=True, **kw).astype(jnp.float32) * wgt)
+
+    got = jax.grad(loss(impl.call, block_q=64, block_k=64),
+                   (0, 1, 2))(q, k, v)
+    ref = jax.grad(loss(oracle.call), (0, 1, 2))(q, k, v)
+    tol = oracle_tol("flash_attention", dtype, "grad")
+    for a, r in zip(got, ref):
+        assert _rel_err(a, r) <= tol
+
+
+def test_flash_triton_interpret_covers_kernel_logic():
+    """On hosts with no GPU the triton backend skips in the registry —
+    but its kernel LOGIC still runs under interpret mode, packed +
+    with_lse + dlse grads included."""
+    impl = get_kernel("flash_attention", "triton").impl
+    oracle = get_kernel("flash_attention", "xla_ref").impl
+    q, k, v = _qkv(jnp.float32, 64, t=64)
+    assert _rel_err(
+        impl.call(q, k, v, causal=True, block_q=32, block_k=32,
+                  interpret=True),
+        oracle.call(q, k, v, causal=True)) <= oracle_tol(
+            "flash_attention", "float32", "fwd")
+    o_t, lse_t = impl.call_with_lse(q, k, v, causal=True,
+                                    interpret=True)
+    o_r, lse_r = oracle.call_with_lse(q, k, v, causal=True)
+    assert _rel_err(lse_t, lse_r) <= 1e-4
+    wgt = jnp.asarray(np.random.default_rng(2).normal(size=q.shape),
+                      jnp.float32)
+
+    def lse_loss(fn, **kw):
+        def f(q, k, v):
+            o, lse = fn(q, k, v, causal=True, **kw)
+            return jnp.sum(o * wgt) + 0.1 * jnp.sum(lse)
+        return f
+
+    gt = jax.grad(lse_loss(impl.call_with_lse, interpret=True),
+                  (0, 1, 2))(q, k, v)
+    gr = jax.grad(lse_loss(oracle.call_with_lse), (0, 1, 2))(q, k, v)
+    for a, r in zip(gt, gr):
+        assert _rel_err(a, r) <= oracle_tol(
+            "flash_attention", "float32", "grad")
+    # packed layout (any head width on the triton path)
+    b, t, h, d = q.shape[0], q.shape[1], q.shape[2], q.shape[3]
+    q2, k2, v2 = (x.reshape(b, t, h * d) for x in (q, k, v))
+    assert _rel_err(
+        impl.call_packed(q2, k2, v2, h, causal=True, interpret=True),
+        oracle.call_packed(q2, k2, v2, h, causal=True)) <= oracle_tol(
+            "flash_attention", "float32", "fwd")
+
+
+@pytest.mark.parametrize("backend", kernels.BACKENDS)
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_ce_oracle_parity_and_grads(backend, dtype):
+    impl = _impl_or_skip("fused_ce", backend)
+    oracle = get_kernel("fused_ce", "xla_ref").impl
+    rng = np.random.default_rng(9)
+    n, d, vocab = 64, 32, 256
+    dt = jnp.dtype(dtype)
+    x = jnp.asarray(rng.normal(size=(n, d)) * 0.3, dt)
+    w = jnp.asarray(rng.normal(size=(d, vocab)) * 0.05, dt)
+    y = jnp.asarray(rng.integers(0, vocab, (n,)), jnp.int32)
+    # small explicit blocks so the vocab axis actually tiles (nv=4)
+    # and the row axis splits — the online-softmax carry is the thing
+    # under test
+    blocks = dict(block_n=32, block_v=64, block_v_fwd=64)
+    assert _rel_err(impl.call(x, w, y, **blocks),
+                    oracle.call(x, w, y)) <= oracle_tol(
+                        "fused_ce", dtype, "fwd")
+    gvec = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    got = jax.grad(lambda x, w: jnp.sum(
+        impl.call(x, w, y, **blocks) * gvec), (0, 1))(x, w)
+    ref = jax.grad(lambda x, w: jnp.sum(oracle.call(x, w, y) * gvec),
+                   (0, 1))(x, w)
+    tol = oracle_tol("fused_ce", dtype, "grad")
+    for a, r in zip(got, ref):
+        assert _rel_err(a, r) <= tol
+
+
+def test_ce_triton_interpret_with_lse_grads():
+    impl = get_kernel("fused_ce", "triton").impl
+    oracle = get_kernel("fused_ce", "xla_ref").impl
+    rng = np.random.default_rng(13)
+    n, d, vocab = 64, 32, 128
+    x = jnp.asarray(rng.normal(size=(n, d)) * 0.3, jnp.float32)
+    w = jnp.asarray(rng.normal(size=(d, vocab)) * 0.05, jnp.float32)
+    y = jnp.asarray(rng.integers(0, vocab, (n,)), jnp.int32)
+    gvec = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+
+    def ml(fn, **kw):
+        def f(x, w):
+            loss, lse = fn(x, w, y, **kw)
+            return jnp.sum(loss * gvec) + 0.1 * jnp.sum(lse)
+        return f
+
+    got = jax.grad(ml(impl.call_with_lse, interpret=True), (0, 1))(x, w)
+    ref = jax.grad(ml(oracle.call_with_lse), (0, 1))(x, w)
+    for a, r in zip(got, ref):
+        assert _rel_err(a, r) <= oracle_tol("fused_ce", "float32",
+                                            "grad")
+
+
+def test_decode_gather_bit_exact_across_backends():
+    from paddle_tpu.kernels.pallas_gather import decode_gather
+
+    oracle = get_kernel("decode_gather", "xla_ref").impl
+    rng = np.random.default_rng(3)
+    for dt in (jnp.float32, jnp.bfloat16):
+        pool = jnp.asarray(rng.normal(size=(9, 4, 2, 8)), dt)
+        table = jnp.asarray(rng.integers(0, 9, (3, 6)), jnp.int32)
+        ref = oracle.call(pool, table)
+        got = decode_gather(pool, table, interpret=True)
+        assert bool(jnp.array_equal(ref, got))
+        assert ref.shape == (3, 24, 2, 8)
+
+
+@pytest.mark.parametrize("backend", ["pallas_tpu", "xla_ref"])
+def test_bit_exact_run_to_run_within_backend(backend):
+    impl = _impl_or_skip("flash_attention", backend)
+    q, k, v = _qkv(jnp.float32, 64, t=64)
+    jf = jax.jit(lambda q, k, v: impl.call(q, k, v, causal=True,
+                                           block_q=32, block_k=32))
+    assert bool(jnp.array_equal(jf(q, k, v), jf(q, k, v)))
+
+
+# -- registry unit suite -----------------------------------------------------
+
+def test_precedence_explicit_arg_beats_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_BACKEND", "xla_ref")
+    assert resolve_name("flash_attention") == "xla_ref"
+    assert resolve_name("flash_attention", "pallas_tpu") == "pallas_tpu"
+
+
+def test_precedence_per_op_env_beats_global(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_BACKEND", "xla_ref")
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_BACKEND_FLASH_ATTENTION",
+                       "pallas_tpu")
+    assert resolve_name("flash_attention") == "pallas_tpu"
+    # the per-op pin does not leak to other op classes
+    assert resolve_name("fused_ce") == "xla_ref"
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(ValueError, match="unknown kernel backend"):
+        resolve_name("flash_attention", "cuda_graphs")
+    with pytest.raises(ValueError, match="unknown kernel backend"):
+        with forced_backend("notabackend"):
+            pass
+
+
+def test_unavailable_backend_raises_with_reason():
+    unavailable = [b for b, ok, _ in
+                   available_backends("flash_attention") if not ok]
+    if not unavailable:
+        pytest.skip("every flash backend is available on this host")
+    with pytest.raises(KernelUnavailable) as ei:
+        resolve_name("flash_attention", unavailable[0])
+    assert ei.value.reason
+
+
+def test_global_env_fallback_to_auto(monkeypatch):
+    # triton registers no decode_gather anywhere: a fleet-wide triton
+    # pin must degrade that op to auto instead of crashing serving
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_BACKEND", "triton")
+    assert resolve_name("decode_gather") in ("pallas_tpu", "xla_ref")
+
+
+def test_forced_backend_scopes_and_restores():
+    before = resolve_name("fused_ce")
+    with forced_backend("xla_ref"):
+        assert resolve_name("fused_ce") == "xla_ref"
+    with forced_backend("xla_ref", op_class="fused_ce"):
+        assert resolve_name("fused_ce") == "xla_ref"
+        # op-scoped force does not leak across op classes
+        assert resolve_name("flash_attention") == resolve_name(
+            "flash_attention", None)
+    assert resolve_name("fused_ce") == before
+
+
+def test_selected_backends_recorded_per_compile():
+    pt.core.unique_name.reset()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        from paddle_tpu.models import transformer
+
+        outs = transformer.build(vocab_size=64, n_layer=1, n_head=2,
+                                 d_model=32, max_len=16,
+                                 dropout_rate=0.0, dtype="float32",
+                                 fused_head=True)
+    scope = pt.core.scope.Scope()
+    pt.core.scope._scope_stack.append(scope)
+    try:
+        exe = pt.Executor()
+        exe.run(startup, scope=scope)
+        toks = np.zeros((2, 16), np.int64)
+        exe.run(main, feed={"tokens": toks, "labels": toks},
+                fetch_list=[outs["avg_cost"]], scope=scope)
+        kb = (exe.last_step_cost or {}).get("kernel_backends")
+        assert kb and kb.get("flash_attention") and kb.get("fused_ce")
+        att = exe.last_attribution or {}
+        assert f"|kb={kb['flash_attention']}" in att.get("workload", "")
+    finally:
+        pt.core.scope._scope_stack.pop()
+
+
+def test_xla_ref_trainer_zero_pallas(monkeypatch):
+    """The acceptance bar at toy scale: env-routed xla_ref GPT training
+    step traces with zero pallas calls (the selftest covers all five
+    memory_optimize policies)."""
+    from paddle_tpu.analysis.jaxpr_tools import walk_report
+    from paddle_tpu.models import transformer
+
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_BACKEND", "xla_ref")
+    pt.core.unique_name.reset()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        outs = transformer.build(vocab_size=64, n_layer=2, n_head=2,
+                                 d_model=32, max_len=16,
+                                 dropout_rate=0.0, dtype="float32",
+                                 fused_head=True)
+        pt.memory_optimize(main, policy="selective")
+    scope = pt.core.scope.Scope()
+    pt.core.scope._scope_stack.append(scope)
+    try:
+        exe = pt.Executor()
+        exe.run(startup, scope=scope)
+        toks = np.zeros((2, 16), np.int64)
+        loss = exe.run(main, feed={"tokens": toks, "labels": toks},
+                       fetch_list=[outs["avg_cost"]], scope=scope)[0]
+        assert np.isfinite(np.asarray(loss)).all()
+        state_names = tuple(sorted(
+            v.name for v in main.persistable_vars()
+            if scope.find_var(v.name) is not None))
+        step, _ = exe.lower(main, ["labels", "tokens"],
+                            [outs["avg_cost"].name], state_names)
+        state = {n: scope.get(n) for n in state_names}
+        state[pt.core.scope.RNG_VAR] = scope.get(pt.core.scope.RNG_VAR)
+        rep = walk_report(jax.make_jaxpr(step)(state, toks, toks))
+        assert rep["pallas_total"] == 0
+    finally:
+        pt.core.scope._scope_stack.pop()
+
+
+def test_timed_run_lint_fires_on_interpret_kernels():
+    if jax.default_backend() == "tpu":
+        pytest.skip("interpret planting needs a non-TPU host")
+    from paddle_tpu.models import transformer
+
+    def compile_under(env_backend):
+        pt.core.unique_name.reset()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            outs = transformer.build(
+                vocab_size=64, n_layer=1, n_head=2, d_model=32,
+                max_len=16, dropout_rate=0.0, dtype="float32",
+                fused_head=True)
+        scope = pt.core.scope.Scope()
+        pt.core.scope._scope_stack.append(scope)
+        try:
+            if env_backend:
+                os.environ["PADDLE_TPU_KERNEL_BACKEND"] = env_backend
+            exe = pt.Executor()
+            with kernels.timed_run():
+                exe.run(startup, scope=scope)
+                toks = np.zeros((2, 16), np.int64)
+                exe.run(main, feed={"tokens": toks, "labels": toks},
+                        fetch_list=[outs["avg_cost"]], scope=scope)
+            return exe.last_step_cost or {}
+        finally:
+            os.environ.pop("PADDLE_TPU_KERNEL_BACKEND", None)
+            pt.core.scope._scope_stack.pop()
+
+    planted = compile_under(None)
+    assert planted.get("interpret_in_timed_run") is True
+    assert "jaxpr.kernel-backend" in (planted.get("lint_checks") or [])
+    clean = compile_under("xla_ref")
+    assert not clean.get("interpret_in_timed_run")
+    assert "jaxpr.kernel-backend" not in (clean.get("lint_checks") or [])
+
+
+# -- tuner integration -------------------------------------------------------
+
+def test_attention_candidates_backend_dimension():
+    from paddle_tpu.tune.space import attention_candidates, prune_static
+
+    plain = attention_candidates(256, 64, 2)
+    assert all("backend" not in c for c in plain)
+    cands = attention_candidates(256, 64, 2,
+                                 backends=("pallas_tpu", "xla_ref"))
+    by_backend = {}
+    for c in cands:
+        by_backend.setdefault(c.get("backend"), []).append(c)
+    assert set(by_backend) == {"pallas_tpu", "xla_ref"}
+    # geometry-free backend contributes ONE candidate, not a cross
+    assert len(by_backend["xla_ref"]) == 1
+    # pruning keeps the xla_ref candidate (VMEM/roofline models are
+    # Pallas-schedule models) while still vmem/roofline-pruning pallas
+    surv, _pruned = prune_static(256, 64, 2, cands)
+    assert any(c.get("backend") == "xla_ref" for c in surv)
+
+
+def test_workload_key_backend_token():
+    from paddle_tpu.tune.space import WorkloadKey
+
+    plain = WorkloadKey("flash", 256, 64, 2, "bfloat16", "cpu",
+                        remat="-")
+    assert "kb=" not in plain.s
+    keyed = WorkloadKey("flash", 256, 64, 2, "bfloat16", "cpu",
+                        remat="-", backend="xla_ref")
+    assert keyed.s.endswith("|kb=xla_ref")
+    assert keyed.s.startswith(plain.s)
+
+
+def test_tuned_winner_backend_reaches_flash_op():
+    """A tuned config that persisted a kernel choice re-resolves on the
+    hot path: multi_head_attention threads it into the flash op's
+    ``backend`` attr."""
+    from paddle_tpu import layers
+    from paddle_tpu.tune import forced_attention_config
+
+    pt.core.unique_name.reset()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with forced_attention_config({"block_q": 128, "block_k": 128,
+                                      "backend": "xla_ref"}):
+            x = layers.data("x", shape=[2, 256, 64], dtype="float32")
+            layers.multi_head_attention(x, x, x, d_model=64, n_head=1,
+                                        causal=True)
+    ops = [op for op in main.global_block().ops
+           if op.type.startswith("flash_attention")]
+    assert ops, "no flash op built"
+    assert ops[0].attrs.get("backend") == "xla_ref"
+    assert ops[0].attrs.get("block_q") == 128
+
+
+def test_cache_fingerprint_covers_registry_surface(monkeypatch):
+    from paddle_tpu.tune import cache as tcache
+
+    base = tcache.geometry_fingerprint()
+    # reordering a platform's auto preference changes what a cached
+    # config resolves to -> the fingerprint must move
+    monkeypatch.setitem(kernels.AUTO_ORDER, "cpu",
+                        ("xla_ref", "pallas_tpu"))
+    assert tcache.geometry_fingerprint() != base
+
+
+def test_tune_search_measures_backend_candidate(tmp_path, monkeypatch):
+    """Live regression for the backend-forced measurement window: a
+    search over a backend-carrying candidate must build, compile,
+    measure and persist the winner's kernel choice (the forced context
+    is single-use — entering it per phase used to crash the search)."""
+    from paddle_tpu.tune import reset_cache, tune_gpt_step
+
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuned.json"))
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "search")
+    reset_cache()
+    try:
+        rep = tune_gpt_step(
+            seq_len=32, n_layer=1, d_model=32, n_head=2, vocab=61,
+            batch=4, dtype="float32", steps=1, warmup=0, repeats=1,
+            block_caps=(32,), policies=("none",), accums=(1,),
+            backends=("xla_ref", "triton"), max_measure=3,
+            mode="search", force=True)
+        assert rep["source"] == "search", rep
+        measured = [m for m in rep["measured"]
+                    if m.get("verdict") == "measured"]
+        assert any(m.get("backend") == "xla_ref" for m in measured)
+        if jax.default_backend() not in ("gpu", "cuda", "rocm"):
+            # a triton REQUEST on a GPU-less host measures the auto
+            # fallback — the record and any winner must carry the
+            # backend that actually ran, never the unavailable request
+            tr = [m for m in measured
+                  if m.get("backend_requested") == "triton"]
+            assert tr and all(m["backend"] != "triton" for m in tr), (
+                measured)
+        assert rep["entry"]["config"].get("backend") not in (None,
+                                                             "triton")
+    finally:
+        reset_cache()
+
+
+def test_truncate_survivors_keeps_every_backend():
+    from paddle_tpu.tune.search import _truncate_survivors
+
+    survivors = ([{"block_q": 64, "backend": "pallas_tpu", "roofline": 1.0}]
+                 * 5 + [{"block_q": 64, "backend": "xla_ref"}])
+    report = {}
+    keep = _truncate_survivors(list(survivors), 3, report)
+    assert any(c.get("backend") == "xla_ref" for c in keep)
+    assert report["truncated_to"] == len(keep) == 4
+    # no truncation -> untouched, no report key
+    report2 = {}
+    same = _truncate_survivors(list(survivors), 10, report2)
+    assert len(same) == 6 and "truncated_to" not in report2

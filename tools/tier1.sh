@@ -106,6 +106,21 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_tune.log >&2
     exit 1
 fi
+# kernel-registry smoke: the multi-backend kernel subsystem — registry
+# resolution + override precedence on this host, oracle parity of every
+# available backend (plus interpret-forced Mosaic/triton kernels)
+# against the pure-XLA reference within the documented tolerances,
+# PADDLE_TPU_KERNEL_BACKEND=xla_ref running the full GPT trainer path
+# under every memory_optimize policy with ZERO Pallas calls in the
+# jaxpr, and the interpret-mode-in-timed-run lint finding planted and
+# detected (docs/kernels.md)
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --kernels-selftest \
+        > /tmp/_t1_kernels.log 2>&1; then
+    echo "TIER1 REGRESSION: kernels selftest failed" >&2
+    cat /tmp/_t1_kernels.log >&2
+    exit 1
+fi
 # attribution smoke: the per-op performance attribution engine + crash
 # flight recorder — the compiled GPT flagship-family step's attribution
 # table covers >= 95% of cost-analysis flops with a tune-style workload
