@@ -651,7 +651,7 @@ def memory_gate():
         # liveness-aware peak when reported (donated weights alias
         # outputs, so summing argument/output/temp overcounts by ~3 GiB
         # here), else argument+output+temp minus aliasing
-        from paddle_tpu.analysis import compiled_memory_stats
+        from paddle_tpu.analysis.hlo_tools import compiled_memory_stats
 
         peak = compiled_memory_stats(compiled)["hbm_high_water_bytes"]
         del state, compiled

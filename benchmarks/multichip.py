@@ -4,7 +4,7 @@ engine measured end to end on a device mesh.
 Runs the transformer flagship (and, in full mode, ResNet and a dp x tp
 mesh) at dp=1 and dp=N through the real Executor and reports per-device
 step time, the compiled step's collective op counts/bytes (split by
-loop membership — ``core/memaudit.comm_report``), optimizer-state bytes
+loop membership — ``analysis.hlo_tools.comm_report``), optimizer-state bytes
 per device under ZeRO-1 vs replicated, and weak-scaling efficiency.
 
 Emits exactly ONE parseable JSON line on stdout (everything else goes to

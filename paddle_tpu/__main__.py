@@ -482,13 +482,18 @@ def cmd_multichip_selftest(args=None):
                 sh = scope.get(moments[0]).sharding
                 return (losses, params, dict(exe.last_step_cost),
                         exe.last_accum_plan,
-                        papi.optimizer_state_report(main_prog, mesh), sh)
+                        papi.optimizer_state_report(main_prog, mesh), sh,
+                        exe.last_comm_plan)
             finally:
                 pt.core.scope._scope_stack.pop()
         finally:
             os.environ.pop("PADDLE_TPU_ZERO", None)
 
-    losses, params, cost, plan, rep, moment_sh = train("1")
+    from paddle_tpu.parallel.contracts import (
+        fsdp_scan_contract, one_boundary_reduce_contract)
+
+    (losses, params, cost, plan, rep, moment_sh,
+     comm_plan) = train("1")
     check(rep["sharded_vars"] > 0
           and "dp" in str(getattr(moment_sh, "spec", "")),
           f"ZeRO-1 accumulators dp-sharded ({rep['sharded_vars']} vars, "
@@ -498,12 +503,17 @@ def cmd_multichip_selftest(args=None):
           f"replicated {rep['total_bytes']} / 4")
     check((plan or {}).get("mode") == "local",
           f"accumulation plan is comm-aware local mode ({plan})")
-    check(cost.get("reduce_ops_in_loop") == 0
-          and (cost.get("reduce_ops") or 0) > 0,
-          f"one cross-chip gradient reduction per optimizer step "
-          f"(reduce_ops={cost.get('reduce_ops')}, "
-          f"in_loop={cost.get('reduce_ops_in_loop')})")
-    losses_r, params_r, _cost_r, _plan_r, rep_r, _sh_r = train("0")
+    # the one-reduction-per-step + zero-in-loop-reduce invariants as a
+    # declarative CommContract over the compiled step's CommPlan
+    # (parallel/contracts.py) — the machine-checked spelling of
+    # docs/parallel.md's comm audit
+    viol = one_boundary_reduce_contract(mesh).check(comm_plan)
+    check(not viol and len(comm_plan) > 0,
+          f"CommContract one-boundary-reduce holds "
+          f"({len(comm_plan)} collectives planned; "
+          f"violations: {[v['message'] for v in viol] or 'none'})")
+    (losses_r, params_r, _cost_r, _plan_r, rep_r, _sh_r,
+     _cp_r) = train("0")
     check(rep_r["sharded_vars"] == 0
           and rep_r["per_device_bytes"] == rep_r["total_bytes"],
           "PADDLE_TPU_ZERO=0 replicates every accumulator")
@@ -553,14 +563,15 @@ def cmd_multichip_selftest(args=None):
                         list(exe.last_remat_plan),
                         papi.sharding_report(main_prog, mesh_f),
                         str(getattr(scope.get(tagged[0]), "sharding",
-                                    None)))
+                                    None)),
+                        exe.last_comm_plan)
             finally:
                 pt.core.scope._scope_stack.pop()
         finally:
             os.environ.pop("PADDLE_TPU_FSDP", None)
 
     (losses_f, grads_f, params_f, cost_f, plan_f, remat_f, rep_f,
-     wsh_f) = train_fsdp("1")
+     wsh_f, comm_plan_f) = train_fsdp("1")
     scanned = [g for g in remat_f if g.get("fsdp")]
     check(bool(scanned) and scanned[0]["fsdp"] > 0,
           f"scan-remat group runs with fsdp-sharded stacked weights "
@@ -574,13 +585,21 @@ def cmd_multichip_selftest(args=None):
           f"(stacked scan weights sharded 4-way)")
     check((plan_f or {}).get("mode") == "local",
           f"fsdp accumulation plan stays comm-aware local ({plan_f})")
-    gathers_in = (cost_f.get("collectives_in_loop") or 0) - (
-        cost_f.get("reduce_ops_in_loop") or 0)
-    check(cost_f.get("reduce_ops_in_loop") == 0 and gathers_in > 0,
-          f"fsdp comm audit: weight gathers INSIDE the scan loop "
-          f"({gathers_in}), zero reduce-class collectives in-loop")
+    # the FSDP comm audit as CommContracts: in-loop fsdp weight gathers
+    # present (the design), zero in-loop reduce-class collectives, one
+    # boundary gradient reduction — evaluated on the structured
+    # CommPlan instead of scalar count arithmetic
+    viol_f = (fsdp_scan_contract(mesh_f).check(comm_plan_f)
+              + one_boundary_reduce_contract(mesh_f).check(comm_plan_f))
+    fsdp_gathers = comm_plan_f.select(kind="all-gather", axis="fsdp",
+                                      in_loop=True)
+    check(not viol_f,
+          f"fsdp CommContracts hold: {len(fsdp_gathers)} in-loop "
+          f"fsdp weight gathers, zero in-loop reduces, boundary "
+          f"reduce present (violations: "
+          f"{[v['message'] for v in viol_f] or 'none'})")
     (losses_f0, grads_f0, params_f0, cost_f0, _plan_f0, _remat_f0,
-     rep_f0, _wsh_f0) = train_fsdp("0")
+     rep_f0, _wsh_f0, _cp_f0) = train_fsdp("0")
     check(rep_f0["params"]["per_device_bytes"]
           == rep_f0["params"]["total_bytes"],
           "PADDLE_TPU_FSDP=0 replicates every parameter")
@@ -921,7 +940,10 @@ def cmd_lint(argv):
         print(e)
         return 2
     if args.as_json:
-        print(_json.dumps(report.to_dict()))
+        # the schema-versioned output contract (stable keys, findings
+        # sorted by severity/id) — CI consumers pin on schema_version
+        # and round-trip via analysis.report_from_json
+        print(_json.dumps(analysis.report_json(report, levels=levels)))
     else:
         for f in report:
             print(repr(f))
@@ -1397,6 +1419,22 @@ def cmd_kernels_selftest(args=None):
     return run_selftest()
 
 
+def cmd_sharding_selftest(args=None):
+    """``python -m paddle_tpu --sharding-selftest``: the sharding &
+    communication contract analyzer's CI gate — three planted
+    constraint-placement violations (a symmetric fsdp pin, an
+    fsdp-composed accumulation grad carry, a forbidden activation
+    reshard) each caught with the right kind/axis/loop attribution on
+    the 8-device CPU mesh; CommPlan mesh-axis recovery + phase
+    classification + ``comm_diff``; and the clean-GPT sweep (every
+    memory_optimize policy x FSDP on/off x ZeRO on/off) reporting zero
+    error-severity comm findings under the attached training
+    contracts (docs/analysis.md "Communication contracts")."""
+    from .analysis.comm.selftest import run_selftest
+
+    return run_selftest()
+
+
 def cmd_resilience_selftest(args=None):
     """``python -m paddle_tpu --resilience-selftest``: the elastic
     resilience engine's CI gate — a trainer subprocess on the 8-device
@@ -1426,6 +1464,8 @@ def main(argv=None):
         return cmd_multichip_selftest()
     if "--lint-selftest" in argv:
         return cmd_lint_selftest()
+    if "--sharding-selftest" in argv:
+        return cmd_sharding_selftest()
     if "--trace-selftest" in argv:
         return cmd_trace_selftest()
     if "--resilience-selftest" in argv:

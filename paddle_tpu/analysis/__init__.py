@@ -14,11 +14,21 @@ to register a new check.
     report.raise_for_errors()     # or lint(..., strict=True)
 
 CLI: ``python -m paddle_tpu --lint <config.py>`` and
-``python -m paddle_tpu --lint-selftest`` (wired into tools/tier1.sh).
-The Executor also folds the program- and hlo-level findings of every
-compile into ``exe.last_step_cost`` (``lint_findings`` /
-``lint_errors`` / ``lint_checks``; kill switch ``PADDLE_TPU_LINT=0``)
-and the trainer JSONL.
+``python -m paddle_tpu --lint-selftest`` /
+``python -m paddle_tpu --sharding-selftest`` (wired into
+tools/tier1.sh).  The Executor also folds the program- and hlo-level
+findings of every compile into ``exe.last_step_cost``
+(``lint_findings`` / ``lint_errors`` / ``lint_checks``; kill switch
+``PADDLE_TPU_LINT=0``) and the trainer JSONL.
+
+The artifact-level TOOLS live in submodules and are imported from
+there, not re-exported here: ``analysis.jaxpr_tools`` (the shared jaxpr
+walk, the checkpoint-name tags), ``analysis.hlo_tools``
+(``hlo_comm_report``, ``compiled_memory_stats``, ``shape_pattern``) and
+``analysis.comm`` (CommPlan extraction, CommContracts, ``comm_diff`` —
+docs/analysis.md "Communication contracts").  This package's namespace
+is the pass FRAMEWORK surface only; the old ``core/memaudit.py``-parity
+re-exports are gone along with the shim module itself.
 """
 
 from .framework import (
@@ -35,36 +45,26 @@ from .framework import (
     compile_findings,
     preflight_hbm,
     lint_enabled,
+    report_json,
+    report_from_json,
+    LINT_JSON_SCHEMA_VERSION,
 )
 
 # importing the check modules registers the seeded checks
 from . import program_checks  # noqa: F401
 from . import jaxpr_checks  # noqa: F401
 from . import hlo_checks  # noqa: F401
+from . import comm  # noqa: F401 — registers the comm-plan checks
 from .hlo_checks import donation_findings
-from .jaxpr_tools import (
-    KERNEL_RESIDUAL_TAG,
-    BLOCK_INPUT_TAG,
-    jaxpr_report,
-    walk_report,
-)
-from .hlo_tools import (
-    REDUCE_COLLECTIVES,
-    hlo_comm_report,
-    comm_report,
-    compiled_memory_stats,
-    shape_pattern,
-)
 
 __all__ = [
     "SEVERITIES", "LEVELS", "Finding", "AnalysisError", "AnalysisReport",
     "ArtifactError", "CheckContext", "register_check", "registered_checks",
     "lint", "compile_findings", "preflight_hbm", "lint_enabled",
+    "report_json", "report_from_json", "LINT_JSON_SCHEMA_VERSION",
     "donation_findings",
-    "KERNEL_RESIDUAL_TAG", "BLOCK_INPUT_TAG", "jaxpr_report",
-    "walk_report", "REDUCE_COLLECTIVES", "hlo_comm_report", "comm_report",
-    "compiled_memory_stats", "shape_pattern",
     "audit_program",
+    "comm",
 ]
 
 
@@ -84,6 +84,9 @@ def audit_program(program, feed, fetch_list, scope=None, layer_count=None,
     startup program into it first).  CPU-safe: used by the tier-1
     regression test and ``python -m paddle_tpu --memory-selftest``.
     """
+    from .hlo_tools import shape_pattern
+    from .jaxpr_tools import jaxpr_report
+
     ctx = CheckContext(program, feed=feed, fetch_list=fetch_list,
                        scope=scope, layer_count=layer_count,
                        donate=False)

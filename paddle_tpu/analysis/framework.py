@@ -44,7 +44,8 @@ import os
 __all__ = [
     "SEVERITIES", "LEVELS", "Finding", "AnalysisError", "AnalysisReport",
     "CheckContext", "ArtifactError", "register_check", "registered_checks",
-    "lint", "compile_findings", "preflight_hbm",
+    "lint", "compile_findings", "preflight_hbm", "report_json",
+    "report_from_json", "LINT_JSON_SCHEMA_VERSION",
 ]
 
 SEVERITIES = ("info", "warning", "error")
@@ -207,7 +208,7 @@ class CheckContext:
 
     def __init__(self, program, feed=None, fetch_list=None, scope=None,
                  mesh=None, layer_count=None, hbm_budget=None, donate=True,
-                 in_loop_expected=False):
+                 in_loop_expected=False, label=None):
         self.program = program
         self.feed = feed
         self.fetch_list = list(fetch_list or [])
@@ -217,6 +218,7 @@ class CheckContext:
         self.hbm_budget = hbm_budget
         self.donate = donate
         self.in_loop_expected = in_loop_expected
+        self.label = label
         self._cache = {}
 
     def seed(self, name, value):
@@ -365,6 +367,20 @@ class CheckContext:
             lambda: hlo_comm_report(self.hlo_text)
             if self.hlo_text else {})
 
+    @property
+    def comm_plan(self):
+        """The structured CommPlan of the compiled step
+        (``analysis.comm.extract_comm_plan``): every collective's kind,
+        recovered mesh axes, bytes, loop membership, phase and
+        provenance.  The Executor's fold-in seeds it from the compile
+        it already did (``exe.last_comm_plan``)."""
+        from .comm.plan import extract_comm_plan
+
+        return self._get(
+            "comm_plan",
+            lambda: extract_comm_plan(
+                self.hlo_text, mesh=self.mesh, label=self.label))
+
 
 
 def _run_checks(ctx, specs, report):
@@ -430,7 +446,8 @@ def lint(program=None, feed=None, fetch_list=None, scope=None,
 
 def compile_findings(program=None, fetch_names=(), compiled=None,
                      memstats=None, comm=None, in_loop_expected=False,
-                     donate=True, hbm_budget=None, kernel_backends=None):
+                     donate=True, hbm_budget=None, kernel_backends=None,
+                     mesh=None, comm_plan=None, label=None):
     """The Executor's compile-time fold-in: run the program-level checks
     plus the hlo-level checks over artifacts the compile already
     produced (no extra trace or compile).  Returns a list of Findings —
@@ -445,7 +462,8 @@ def compile_findings(program=None, fetch_names=(), compiled=None,
     kernels in a timed measurement (docs/kernels.md)."""
     ctx = CheckContext(
         program, fetch_list=list(fetch_names), donate=donate,
-        hbm_budget=hbm_budget, in_loop_expected=in_loop_expected)
+        hbm_budget=hbm_budget, in_loop_expected=in_loop_expected,
+        mesh=mesh, label=label)
     if compiled is not None:
         ctx.seed("compiled", compiled)
     if memstats is not None:
@@ -454,6 +472,15 @@ def compile_findings(program=None, fetch_names=(), compiled=None,
         ctx.seed("comm", comm)
     elif compiled is None:
         ctx.seed("comm", {})
+    if comm_plan is not None:
+        ctx.seed("comm_plan", comm_plan)
+    elif compiled is None or mesh is None:
+        # off-mesh there are no collectives and no axes to attribute:
+        # seed the empty plan so no comm check forces an expensive
+        # compiled.as_text() render (the comm={} discipline)
+        from .comm.plan import CommPlan
+
+        ctx.seed("comm_plan", CommPlan([], {}, label))
     specs = []
     if program is not None:
         specs += [s for s in _CHECKS.values() if s.level == "program"]
@@ -521,6 +548,59 @@ def preflight_hbm(high_water_bytes, budget_bytes, context=""):
              "gradient_accumulation, or shard over more chips",
         data={"hbm_high_water_bytes": int(high_water_bytes),
               "budget_bytes": int(budget_bytes)})]
+
+
+# the versioned ``--lint --json`` output contract.  Bump ONLY when a
+# key is renamed/removed or a meaning changes; adding keys is
+# backward-compatible and needs no bump.  CI consumers pin on this.
+LINT_JSON_SCHEMA_VERSION = 1
+
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+def report_json(report, levels=None):
+    """The schema-versioned JSON form of an ``AnalysisReport`` — the
+    ``python -m paddle_tpu --lint --json`` output contract.
+
+    Stable keys: ``schema_version``, ``levels`` (the artifact levels
+    that ran), ``findings`` (each with ALL of check / severity / level /
+    location / message / hint / data — ``data`` is ``{}`` when a check
+    attached none), ``counts`` and ``ok``.  Findings sort by severity
+    (errors first), then check id, location and message, so the output
+    is deterministic for diffing.  ``report_from_json`` round-trips."""
+    findings = sorted(
+        report.findings,
+        key=lambda f: (-_SEV_RANK[f.severity], f.check, f.location,
+                       f.message))
+    return {
+        "schema_version": LINT_JSON_SCHEMA_VERSION,
+        "levels": list(levels if levels is not None else LEVELS),
+        "findings": [
+            {"check": f.check, "severity": f.severity, "level": f.level,
+             "location": f.location, "message": f.message,
+             "hint": f.hint, "data": dict(f.data)}
+            for f in findings
+        ],
+        "counts": report.counts(),
+        "ok": report.ok,
+    }
+
+
+def report_from_json(obj):
+    """Rebuild an ``AnalysisReport`` from ``report_json`` output (the
+    round-trip half of the contract).  Refuses newer schema versions —
+    a consumer built against v1 must not silently misread v2."""
+    version = obj.get("schema_version")
+    if version is None or int(version) > LINT_JSON_SCHEMA_VERSION:
+        raise ValueError(
+            f"unsupported lint JSON schema_version {version!r} "
+            f"(this build reads <= {LINT_JSON_SCHEMA_VERSION})")
+    return AnalysisReport([
+        Finding(f["check"], f["severity"], f["level"], f["location"],
+                f["message"], hint=f.get("hint", ""),
+                data=f.get("data") or None)
+        for f in obj.get("findings", ())
+    ])
 
 
 def lint_enabled():

@@ -13,16 +13,20 @@ iteration, one at top level executes once per step.
 import re
 
 __all__ = [
-    "REDUCE_COLLECTIVES", "hlo_comm_report", "comm_report",
+    "REDUCE_COLLECTIVES", "GATHER_COLLECTIVES", "ALL_COLLECTIVES",
+    "hlo_comm_report", "comm_report", "loop_computations",
     "compiled_memory_stats", "shape_pattern",
 ]
 
 # collectives that REDUCE across chips (gradient aggregation); gathers /
 # permutes move activations and are reported separately
 REDUCE_COLLECTIVES = ("all-reduce", "reduce-scatter")
-_GATHER_COLLECTIVES = ("all-gather", "collective-permute", "all-to-all",
-                       "collective-broadcast")
-_ALL_COLLECTIVES = REDUCE_COLLECTIVES + _GATHER_COLLECTIVES
+GATHER_COLLECTIVES = ("all-gather", "collective-permute", "all-to-all",
+                      "collective-broadcast")
+ALL_COLLECTIVES = REDUCE_COLLECTIVES + GATHER_COLLECTIVES
+# legacy aliases (pre-ISSUE-14 private names)
+_GATHER_COLLECTIVES = GATHER_COLLECTIVES
+_ALL_COLLECTIVES = ALL_COLLECTIVES
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
@@ -70,6 +74,39 @@ def _collective_bytes(shape_text, is_start):
     return sum(sizes)
 
 
+def loop_computations(text):
+    """Names of every computation reachable from a while body/condition
+    in optimized HLO ``text`` — the one-level call graph (``calls=`` /
+    ``to_apply=`` / ``branch_computations=``) closed over the loop
+    bodies.  An op inside any of these executes once per loop
+    iteration.  The single source of the loop-membership discipline:
+    ``hlo_comm_report`` and the CommPlan extractor
+    (``analysis.comm.plan``) both classify with it."""
+    bodies = set(re.findall(r"body=%?([\w.\-]+)", text))
+    bodies |= set(re.findall(r"condition=%?([\w.\-]+)", text))
+    edges = {}
+    cur = None
+    for line in text.splitlines():
+        m = _COMP_RE.match(line)
+        if m:
+            cur = m.group(1)
+        head = line.split(" metadata=", 1)[0]
+        for ref in _CALL_RE.findall(head):
+            edges.setdefault(cur, set()).add(ref)
+        for grp in _BRANCH_RE.findall(head):
+            for ref in grp.split(","):
+                edges.setdefault(cur, set()).add(ref.strip().lstrip("%"))
+    in_loop = set()
+    frontier = list(bodies)
+    while frontier:
+        c = frontier.pop()
+        if c in in_loop:
+            continue
+        in_loop.add(c)
+        frontier.extend(edges.get(c, ()))
+    return in_loop
+
+
 def hlo_comm_report(text):
     """Parse optimized (post-SPMD) HLO text and report every cross-chip
     collective: static counts and output bytes per kind, split by whether
@@ -89,12 +126,9 @@ def hlo_comm_report(text):
     * ``collectives_in_loop`` / ``collective_bytes_in_loop``: all kinds
       (attention-internal gathers land here — reported, not gated).
     """
-    bodies = set(re.findall(r"body=%?([\w.\-]+)", text))
-    bodies |= set(re.findall(r"condition=%?([\w.\-]+)", text))
-
-    # one-level call graph so a collective inside a computation CALLED
-    # from a while body still counts as in-loop
-    edges = {}
+    # loop membership via the shared call-graph walk (a collective
+    # inside a computation CALLED from a while body counts as in-loop)
+    in_loop = loop_computations(text)
     cur = None
     colls = []  # (kind, bytes, computation)
     for line in text.splitlines():
@@ -102,27 +136,12 @@ def hlo_comm_report(text):
         if m:
             cur = m.group(1)
         head = line.split(" metadata=", 1)[0]
-        for ref in _CALL_RE.findall(head):
-            edges.setdefault(cur, set()).add(ref)
-        for grp in _BRANCH_RE.findall(head):
-            for ref in grp.split(","):
-                edges.setdefault(cur, set()).add(
-                    ref.strip().lstrip("%"))
         cm = _COLL_RE.search(head)
         if cm:
             colls.append((cm.group(2),
                           _collective_bytes(cm.group(1),
                                             bool(cm.group(3))),
                           cur))
-
-    in_loop = set()
-    frontier = list(bodies)
-    while frontier:
-        c = frontier.pop()
-        if c in in_loop:
-            continue
-        in_loop.add(c)
-        frontier.extend(edges.get(c, ()))
 
     report = {
         "collective_ops": {},

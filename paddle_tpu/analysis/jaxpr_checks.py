@@ -145,6 +145,83 @@ def kernel_backend(ctx):
               "total": rep["pallas_total"]})]
 
 
+# the blessed accum-carry pin axes: the carry shards its GROUP axis over
+# dp and NOTHING else (docs/parallel.md constraint-placement rule 3)
+_ACCUM_CARRY_OK_AXES = {"dp"}
+
+
+@register_check("jaxpr.constraint-placement", level="jaxpr")
+def constraint_placement(ctx):
+    """The three blessed constraint-placement sites are the ONLY
+    ``with_sharding_constraint``s allowed inside scan bodies
+    (docs/parallel.md): the two ``_fsdp_fwd_pin`` custom-vjp pins
+    (forward-only — a symmetric pin transposes into the backward and
+    forces per-layer dW replication: measured 19-49 in-loop all-reduces)
+    and the accumulation carry's plain-``dp`` group pin (an
+    fsdp-composed carry makes GSPMD feature-shard the saved residuals).
+    The Executor marks each blessed site with a ``pt_pin[site]`` named
+    scope; this check errors on any in-scan constraint that lacks the
+    marker, and on a marked ``accum_carry`` pin whose spec strays off
+    the plain-dp contract."""
+    from .comm.plan import PIN_SCOPE_RE
+
+    unblessed = {}   # (axes, depth) -> [records]
+    bad_carry = {}   # axes -> [records]
+    for sc in ctx.walk.get("sharding_constraints", ()):
+        if sc["scan_depth"] <= 0:
+            continue  # boundary-level constraints are the blessed zone
+        m = PIN_SCOPE_RE.search(sc["scope"] or "")
+        if m and m.group(1) == "shard":
+            # a DECLARED activation annotation (parallel.shard_activation
+            # -> pt_shard[var]): not a rogue constraint — its comm cost
+            # is policed by hlo.accidental-reshard and the contract
+            # checks, which attribute it to the var and can bless it
+            # via CommContract.expect(...)
+            continue
+        site = m.group(2) if m else None
+        axes = tuple(sorted(sc.get("axes") or ()))
+        if site is None:
+            unblessed.setdefault(
+                (axes, sc["scan_depth"]), []).append(sc)
+        elif site.startswith("accum_carry") and \
+                not set(axes) <= _ACCUM_CARRY_OK_AXES:
+            bad_carry.setdefault(axes, []).append(sc)
+    findings = []
+    for (axes, depth), recs in sorted(unblessed.items()):
+        findings.append(ctx.finding(
+            "jaxpr.constraint-placement", "error", "jaxpr",
+            f"scan depth {depth}",
+            f"{len(recs)} with_sharding_constraint(s) over axes "
+            f"{list(axes) or ['<replicated>']} inside scan bodies are "
+            f"not one of the blessed pin sites — a symmetric "
+            f"constraint transposes into the backward scan and turns "
+            f"per-layer gradients/residuals into in-loop collectives "
+            f"(e.g. scope: {recs[0]['scope'] or '<none>'})",
+            hint="use the Executor's forward-only pin discipline "
+                 "(_fsdp_fwd_pin / the pt_pin[...] sites, "
+                 "docs/parallel.md); if this movement is intentional, "
+                 "declare it in a CommContract and lift the "
+                 "constraint out of the loop body",
+            data={"axes": list(axes), "scan_depth": depth,
+                  "count": len(recs), "constraints": recs[:4]}))
+    for axes, recs in sorted(bad_carry.items()):
+        extra = sorted(set(axes) - _ACCUM_CARRY_OK_AXES)
+        findings.append(ctx.finding(
+            "jaxpr.constraint-placement", "error", "jaxpr",
+            "pt_pin[accum_carry]",
+            f"{len(recs)} accumulation-carry pin(s) constrained over "
+            f"axes {list(axes)} — the blessed spelling keeps the "
+            f"carry plain P('dp'); composing {extra} onto it makes "
+            f"GSPMD feature-shard the saved residuals (in-loop "
+            f"LN/softmax partial sums + all-reduces)",
+            hint="keep the carry's pin at P('dp') and let the "
+                 "optimizer-boundary pin reshard gradients once, "
+                 "outside every loop (docs/parallel.md)",
+            data={"axes": list(axes), "count": len(recs),
+                  "constraints": recs[:4]}))
+    return findings
+
+
 @register_check("jaxpr.bf16-accum", level="jaxpr")
 def bf16_accum(ctx):
     """Reduced-precision accumulation lint: an ``acc = acc + delta``

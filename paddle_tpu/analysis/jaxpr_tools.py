@@ -118,6 +118,34 @@ def _carry_accumulations(eqn):
     return out
 
 
+def _constraint_record(eqn, depth):
+    """One ``sharding_constraint`` eqn flattened for the
+    constraint-placement check: scan depth, the named-scope stack it was
+    traced under, and the mesh axes its spec mentions."""
+    import re as _re
+
+    scope = ""
+    try:
+        scope = str(eqn.source_info.name_stack)
+    except Exception:
+        pass
+    sh = eqn.params.get("sharding")
+    spec = getattr(sh, "spec", None)
+    axes = set()
+    if spec is not None:
+        for entry in spec:
+            for a in (entry if isinstance(entry, tuple)
+                      else (entry,) if entry else ()):
+                # P.UNCONSTRAINED is truthy but names no mesh axis
+                if a and str(a) != "UNCONSTRAINED":
+                    axes.add(str(a))
+    elif sh is not None:
+        axes.update(_re.findall(r"'(\w+)'", str(sh)))
+    return {"scan_depth": depth, "scope": scope,
+            "spec": str(spec) if spec is not None else str(sh),
+            "axes": sorted(axes)}
+
+
 def walk_report(jaxpr, layer_counts=()):
     """One traversal of a (Closed)Jaxpr feeding every jaxpr-level check.
 
@@ -134,7 +162,11 @@ def walk_report(jaxpr, layer_counts=()):
       ``REDUCE_ACCUM_MIN_ELEMS`` elements per output element with a
       reduced-precision operand AND result;
     * ``tanh_in_scan``: count of ``tanh`` eqns inside scan/while bodies
-      (the reassociation-stability hazard for scanned remat bodies).
+      (the reassociation-stability hazard for scanned remat bodies);
+    * ``sharding_constraints``: every ``sharding_constraint`` eqn with
+      its scan depth, named-scope stack (the ``pt_pin[site]`` blessed
+      markers — ``jaxpr.constraint-placement``'s input), spec string
+      and the mesh axes the spec mentions.
 
     ``layer_counts``: leading-dim candidates for the layer-stacked
     probes (the BENCH_r05 shape detector accepts several hypotheses —
@@ -156,6 +188,7 @@ def walk_report(jaxpr, layer_counts=()):
         "low_precision_carries": [],
         "low_precision_reduces": [],
         "tanh_in_scan": 0,
+        "sharding_constraints": [],
     }
 
     def walk(jx, depth):
@@ -186,6 +219,9 @@ def walk_report(jaxpr, layer_counts=()):
                     report["name_tags"].add(str(tag))
             elif name == "tanh" and depth > 0:
                 report["tanh_in_scan"] += 1
+            elif name == "sharding_constraint":
+                report["sharding_constraints"].append(
+                    _constraint_record(eqn, depth))
             elif name == "reduce_sum":
                 iv = eqn.invars[0] if eqn.invars else None
                 ov = eqn.outvars[0] if eqn.outvars else None

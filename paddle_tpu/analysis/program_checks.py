@@ -305,6 +305,86 @@ def shape_dtype(ctx):
     return findings
 
 
+@register_check("program.spec-conflict", level="program")
+def spec_conflict(ctx):
+    """Sharding specs that cannot hold on the declared shapes, flagged
+    BEFORE any compile: an explicit ``partition_spec`` whose axis
+    product does not divide the static dim it shards, or an
+    ``fsdp_param`` tag whose tp x fsdp tuple-composition
+    (``fsdp_spec_for``'s rule) is indivisible on the leading dim.  At
+    compile time these fall back to replication (recorded by
+    ``program.shard-fallback``); this check is the cheaper, earlier
+    signal — a capacity config relying on the shard OOMs at startup
+    otherwise.  Needs a mesh (``lint(mesh=...)``) — silent without
+    one."""
+    mesh = ctx.mesh
+    if mesh is None:
+        return []
+    mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    from ..parallel.mesh import axis_size
+
+    nf = axis_size(mesh, "fsdp")
+    block = ctx.program.global_block()
+    findings = []
+
+    def axes_of(entry):
+        return tuple(a for a in (
+            entry if isinstance(entry, tuple) else (entry,))
+            if a)
+
+    for name in sorted(block.vars):
+        var = block.vars[name]
+        shape = tuple(var.shape or ())
+        spec = list(getattr(var, "partition_spec", None) or ())
+        for d, entry in enumerate(spec):
+            if entry is None or d >= len(shape):
+                continue
+            axes = axes_of(entry)
+            denom = 1
+            for a in axes:
+                denom *= mesh_sizes.get(a, 1)
+            dim = int(shape[d]) if shape[d] else 0
+            if denom > 1 and dim > 0 and dim % denom:
+                findings.append(ctx.finding(
+                    "program.spec-conflict", "warning", "program",
+                    f"var {name}",
+                    f"dim {d} ({dim}) of {name!r} is annotated "
+                    f"P over {'x'.join(axes)}={denom} but is not "
+                    f"divisible — the spec cannot hold and will "
+                    f"fall back to replication at compile",
+                    hint="pad the dim to a multiple of the sharding "
+                         "axes' product, or drop an axis from the "
+                         "composition",
+                    data={"var": name, "dim": d, "size": dim,
+                          "axes": list(axes), "product": denom}))
+        if nf > 1 and getattr(var, "fsdp_param", False) and shape \
+                and "fsdp" not in {a for e in spec for a in axes_of(e)}:
+            lead = axes_of(spec[0]) if spec else ()
+            if "dp" in lead:
+                continue  # fsdp_spec_for declines these with a reason
+            denom = nf
+            for a in lead:
+                denom *= mesh_sizes.get(a, 1)
+            dim = abs(int(shape[0])) if shape[0] else 0
+            if dim and dim % denom:
+                findings.append(ctx.finding(
+                    "program.spec-conflict", "warning", "program",
+                    f"var {name}",
+                    f"fsdp composition on {name!r} needs leading dim "
+                    f"{dim} divisible by "
+                    f"{'x'.join([*lead, 'fsdp'])}={denom} — the "
+                    f"tp/fsdp tuple spec cannot hold and the weight "
+                    f"will stay {'tp-sharded only' if lead else 'replicated'}",
+                    hint="choose an fsdp degree dividing the weight's "
+                         "leading dim (or accept the recorded "
+                         "replication fallback)",
+                    data={"var": name, "size": dim,
+                          "axes": [*lead, "fsdp"], "product": denom}))
+        if len(findings) >= MAX_FINDINGS:
+            break
+    return findings
+
+
 @register_check("program.shard-fallback", level="program")
 def shard_fallback(ctx):
     """Sharding fallbacks recorded at spec-resolution time

@@ -134,7 +134,7 @@ def _tag_named(v, tag):
     return v
 
 
-def _fsdp_fwd_pin(sharding):
+def _fsdp_fwd_pin(sharding, site="fsdp"):
     """Forward-only sharding constraint: the primal is pinned to
     ``sharding``, the cotangent passes through UNPINNED.  Both FSDP
     pins use it — the at-rest stack pin (``P(None, *spec)``: at-rest
@@ -142,6 +142,14 @@ def _fsdp_fwd_pin(sharding):
     (the fsdp-free spec: GSPMD emits the all-gather inside the loop
     body and XLA frees the gathered copy when the iteration's uses
     finish).
+
+    ``site`` names the blessed constraint-placement site: the pin is
+    applied under a ``pt_pin[site]`` named scope, which (a) marks it
+    blessed for the ``jaxpr.constraint-placement`` check — any in-scan
+    constraint WITHOUT the marker is an error — and (b) rides the HLO
+    ``op_name`` metadata so the CommPlan extractor attributes the
+    collectives GSPMD derives from this pin back to the site
+    (docs/analysis.md "Communication contracts").
 
     Why not a plain ``with_sharding_constraint``?  It transposes to
     itself, constraining the BACKWARD too — the gather's transpose
@@ -155,18 +163,34 @@ def _fsdp_fwd_pin(sharding):
     elementwise update against the fsdp-sharded moments reads them
     shard-locally (a free slice, outside every loop)."""
 
+    scope = f"pt_pin[{site}]"
+
     @jax.custom_vjp
     def pin(x):
-        return jax.lax.with_sharding_constraint(x, sharding)
+        with jax.named_scope(scope):
+            return jax.lax.with_sharding_constraint(x, sharding)
 
     def pin_fwd(x):
-        return jax.lax.with_sharding_constraint(x, sharding), None
+        with jax.named_scope(scope):
+            return jax.lax.with_sharding_constraint(x, sharding), None
 
     def pin_bwd(_, ct):
         return (ct,)
 
     pin.defvjp(pin_fwd, pin_bwd)
     return pin
+
+
+def _accum_carry_spec(lead):
+    """The accumulation carry's pin spec: the GROUP axis shards over
+    plain ``dp`` and nothing else (docs/parallel.md constraint-placement
+    rule 3 — an fsdp-composed carry makes GSPMD feature-shard the saved
+    residuals into in-loop partial sums).  Module-level so the sharding
+    selftest can plant the composed-spelling defect and prove the
+    ``jaxpr.constraint-placement`` check catches it."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*([None] * lead + ["dp"]))
 
 
 def _ensure_barrier_batch_rule():
@@ -358,12 +382,68 @@ def _gather_input(env, block, name, inside_grad_prefix):
     return val
 
 
+def _activation_shard_specs(program):
+    """Sharding annotations on non-persistable INTERMEDIATES
+    (``parallel.shard_activation``): ``{var_name: PartitionSpec}``,
+    cached on the program per version.  Parameters, data feeds and
+    persistables are excluded — they have their own sharding paths
+    (``compile_shardings``, the boundary pin)."""
+    cached = getattr(program, "_act_shard_cache", None)
+    if cached is not None and cached[0] == program._version:
+        return cached[1]
+    specs = {}
+    for blk in program.blocks:
+        for n, var in blk.vars.items():
+            if var.persistable or getattr(var, "is_data", False) \
+                    or isinstance(var, Parameter):
+                continue
+            spec = getattr(var, "partition_spec", None)
+            if spec is not None:
+                specs[n] = spec
+    program._act_shard_cache = (program._version, specs)
+    return specs
+
+
+def _apply_activation_spec(ctx, name, spec, val):
+    """Pin one annotated intermediate to its ``partition_spec``.  Always
+    called inside the ``pt_shard[var]`` named scope (see
+    ``run_block_ops``): the scope wraps BOTH the producing op's lowering
+    and this pin, because the SPMD partitioner absorbs the constraint
+    custom-call itself — the reshard collectives it inserts inherit the
+    surrounding ops' metadata, and that metadata is what lets the
+    CommPlan extractor attribute them back to the variable
+    (``hlo.accidental-reshard``, ``CommContract.forbid_reshard``)."""
+    try:
+        if len(spec) > np.ndim(val):
+            return val
+        from jax.sharding import NamedSharding
+
+        return jax.lax.with_sharding_constraint(
+            val, NamedSharding(ctx.executor.mesh, spec))
+    except Exception:  # noqa: BLE001 — an unplaceable annotation must
+        return val     # not kill the trace; spec-conflict lint names it
+
+
 def run_block_ops(ctx, block, ops, env, inside_grad_prefix=False):
     """Trace-time evaluation of a list of OpDescs over a name->array env."""
+    act_specs = (
+        _activation_shard_specs(ctx.program)
+        if ctx.program is not None
+        and getattr(ctx.executor, "mesh", None) is not None else {})
     for op in ops:
         impl = get_op_impl(op.type)
         if impl.raw:
             impl.fn(ctx, block, op, env)
+            if act_specs:
+                # raw (control-flow) ops write env themselves — apply
+                # any annotated output's pin here so shard_activation
+                # on a while/scan-block output is never a silent no-op
+                for names in op.outputs.values():
+                    for n in names:
+                        if n in act_specs and n in env:
+                            with jax.named_scope(f"pt_shard[{n}]"):
+                                env[n] = _apply_activation_spec(
+                                    ctx, n, act_specs[n], env[n])
             continue
         force_stop = inside_grad_prefix and impl.nondiff
         ins = {}
@@ -379,8 +459,26 @@ def run_block_ops(ctx, block, ops, env, inside_grad_prefix=False):
         attrs = dict(op.attrs)
         if impl.stateful_rng and "_key" not in attrs:
             attrs["_key"] = ctx.next_op_key()
+        pin_names = ()
+        if act_specs:
+            pin_names = tuple(
+                n for names in op.outputs.values() for n in names
+                if n in act_specs)
         try:
-            outs = impl.call(ins, attrs, ctx)
+            if pin_names:
+                # the pt_shard[vars] scope wraps the WHOLE lowering of
+                # the producing op (not just the constraint): GSPMD
+                # attaches its reshard collectives to these ops'
+                # metadata, which is the provenance the comm analyzer
+                # attributes reshards by.  ALL annotated outputs join
+                # the scope name — provenance matching is a regex
+                # search, so a forbid_reshard pattern on any of them
+                # still fires.
+                with jax.named_scope(
+                        f"pt_shard[{','.join(pin_names)}]"):
+                    outs = impl.call(ins, attrs, ctx)
+            else:
+                outs = impl.call(ins, attrs, ctx)
         except Exception as e:
             raise RuntimeError(f"error lowering {op}: {e}") from e
         outs = outs or {}
@@ -396,6 +494,10 @@ def run_block_ops(ctx, block, ops, env, inside_grad_prefix=False):
                     f"values for {len(names)} variables"
                 )
             for n, v in zip(names, vals):
+                if n in act_specs:
+                    with jax.named_scope(f"pt_shard[{n}]"):
+                        v = _apply_activation_spec(
+                            ctx, n, act_specs[n], v)
                 env[n] = v
 
 
@@ -428,6 +530,11 @@ class Executor:
         # coverage vs cost_analysis, tune-style workload key).  None
         # until a compile runs with PADDLE_TPU_ATTR on.
         self.last_attribution = None
+        # Most recent mesh compile's structured CommPlan
+        # (analysis.comm.CommPlan: per-collective kind / mesh axes /
+        # bytes / loop membership / phase / provenance) — what
+        # CommContracts and comm_diff consume.  None off-mesh.
+        self.last_comm_plan = None
 
     def _fsdp_active(self, program):
         """True when the scan-remat body should gather FSDP-sharded
@@ -521,7 +628,7 @@ class Executor:
                 cost["bytes_accessed"] = float(b) if b else None
         except Exception:
             pass  # some backends/plugins don't implement cost analysis
-        from ..analysis import compiled_memory_stats
+        from ..analysis.hlo_tools import compiled_memory_stats
 
         memstats = compiled_memory_stats(compiled)
         if memstats:
@@ -547,16 +654,33 @@ class Executor:
                 help="compiled-step HBM high-water (memory_analysis)",
             ).set_max(high)
         comm = None
+        comm_plan = None
         if self.mesh is not None:
-            # cross-chip communication accounting (analysis.comm_report):
-            # static collective op counts/bytes of the compiled step, with
-            # the load-bearing loop split — a reduce op inside a while
-            # body pays once per microbatch, one outside pays once per
-            # step.  Lands in last_step_cost (bench/trainer JSON channel)
-            # and the registry, mirroring the hbm_high_water plumbing.
-            from ..analysis import comm_report
+            # cross-chip communication accounting
+            # (analysis.hlo_tools.hlo_comm_report): static collective op
+            # counts/bytes of the compiled step, with the load-bearing
+            # loop split — a reduce op inside a while body pays once per
+            # microbatch, one outside pays once per step.  Lands in
+            # last_step_cost (bench/trainer JSON channel) and the
+            # registry, mirroring the hbm_high_water plumbing.  The
+            # same HLO text also yields the structured CommPlan
+            # (analysis.comm): per-collective mesh axes, phase and
+            # provenance — exe.last_comm_plan carries the full plan,
+            # the cost dict its compact per-bucket summary.
+            from ..analysis.comm import extract_comm_plan
 
-            comm = comm_report(compiled)
+            try:
+                hlo_text = compiled.as_text() or ""
+            except Exception:  # noqa: BLE001 — backend can't render
+                hlo_text = ""
+            comm_plan = extract_comm_plan(
+                hlo_text, mesh=self.mesh, label=label)
+            # the scalar report derives from the plan: ONE parse of the
+            # (potentially huge) HLO text serves both shapes
+            comm = comm_plan.comm_report() if hlo_text else {}
+            self.last_comm_plan = comm_plan
+            if len(comm_plan):
+                cost["comm_plan"] = comm_plan.summary()
             if comm:
                 cost["collective_count"] = comm["collective_count"]
                 cost["collective_bytes"] = comm["collective_bytes"]
@@ -633,7 +757,8 @@ class Executor:
                     comm=comm if self.mesh is not None else {},
                     in_loop_expected=label.startswith("scan"),
                     donate=self.donate_state,
-                    kernel_backends=kernel_backends)
+                    kernel_backends=kernel_backends,
+                    mesh=self.mesh, comm_plan=comm_plan, label=label)
             except Exception:  # noqa: BLE001 — lint must never block a run
                 findings = []
             cost["lint_findings"] = len(findings)
@@ -1225,12 +1350,14 @@ class Executor:
                                         xs_stacked[n] = \
                                             _fsdp_fwd_pin(
                                                 _NS(self.mesh,
-                                                    _PS(None, *spec)))(
+                                                    _PS(None, *spec)),
+                                                site=f"fsdp_stack:{n}")(
                                                 xs_stacked[n])
                                         fsdp_gather[n] = \
                                             _fsdp_fwd_pin(
                                                 _NS(self.mesh,
-                                                    gathered))
+                                                    gathered),
+                                                site=f"fsdp_gather:{n}")
                                 carry0 = {n: e[n] for n in carry_map}
                                 # offload ("host"/"save"): the ONE change
                                 # vs plain selective execution is that
@@ -1502,9 +1629,11 @@ class Executor:
                         # sharding_report accounts grads at this spec
                         spec = (getattr(var, "partition_spec", None)
                                 if var is not None else None) or _P()
-                        env[n + GRAD_SUFFIX] = (
-                            jax.lax.with_sharding_constraint(
-                                g, NamedSharding(self.mesh, spec)))
+                        with jax.named_scope(
+                                f"pt_pin[grad_boundary:{n}]"):
+                            env[n + GRAD_SUFFIX] = (
+                                jax.lax.with_sharding_constraint(
+                                    g, NamedSharding(self.mesh, spec)))
                 else:
                     for n, g in grads.items():
                         env[n + GRAD_SUFFIX] = g
@@ -1705,14 +1834,19 @@ class Executor:
         equal-weight-mean-loss contract of ``gradient_accumulation``);
         float summation ORDER differs, so vs dp=1 this is
         close-not-bit-identical, like any resharding."""
-        from jax.sharding import NamedSharding, PartitionSpec
+        from jax.sharding import NamedSharding
 
         mesh = self.mesh
 
         def dp_sharded(x, lead=0):
-            spec = PartitionSpec(*([None] * lead + ["dp"]))
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, spec))
+            # the blessed accum-carry pin (docs/parallel.md rule 3):
+            # plain dp on the group axis, marked pt_pin[accum_carry] so
+            # the constraint-placement check can verify BOTH the site
+            # and the spec (an fsdp-composed carry is an error even
+            # when marked)
+            with jax.named_scope("pt_pin[accum_carry]"):
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, _accum_carry_spec(lead)))
 
         xs_feeds = {}
         for n, mb in mbs.items():

@@ -145,6 +145,11 @@ class MetricsReporter:
                 collective_count=sc.get("collective_count"),
                 collective_bytes=sc.get("collective_bytes"),
                 reduce_ops_in_loop=sc.get("reduce_ops_in_loop"),
+                # the structured comm plan's per-bucket summary
+                # (analysis.comm: kind/axes/phase/in-loop -> count,
+                # bytes) — which collective moved is diffable across
+                # JSONL rows via analysis.comm.comm_diff
+                comm_plan=sc.get("comm_plan"),
                 # static-analysis findings of the compiled step (the
                 # analysis engine's fold-in via Executor._aot_compile)
                 lint_findings=sc.get("lint_findings"),

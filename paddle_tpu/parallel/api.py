@@ -40,9 +40,10 @@ from ..core.scope import RNG_VAR
 from .mesh import axis_size
 
 __all__ = ["compile_shardings", "data_parallel", "shard_parameter",
-           "replicate", "P", "zero_spec_for", "fsdp_spec_for",
-           "shard_fsdp", "optimizer_state_report", "sharding_report",
-           "comm_overlap_flags", "enable_comm_overlap"]
+           "shard_activation", "replicate", "P", "zero_spec_for",
+           "fsdp_spec_for", "shard_fsdp", "optimizer_state_report",
+           "sharding_report", "comm_overlap_flags",
+           "enable_comm_overlap"]
 
 
 def _zero_enabled():
@@ -393,6 +394,33 @@ def shard_fsdp(program, programs=()):
         if hasattr(program, "_fsdp"):
             prog._fsdp = program._fsdp
     return sorted(names)
+
+
+def shard_activation(var, spec):
+    """Annotate a non-persistable INTERMEDIATE with a PartitionSpec —
+    e.g. sequence-sharding a long activation.  The Executor pins the
+    produced value to ``spec`` under a ``pt_shard[var]`` named scope
+    (``core/executor._apply_activation_spec``), so every collective
+    GSPMD derives from the annotation is attributable back to this var
+    in the CommPlan — which is also how the ``hlo.accidental-reshard``
+    check and ``CommContract.forbid_reshard`` police annotations that
+    silently cost gather/reduce traffic (docs/analysis.md
+    "Communication contracts").  Parameters take ``shard_parameter``;
+    data feeds take ``data_parallel``."""
+    if getattr(var, "persistable", False) or getattr(var, "is_data",
+                                                     False):
+        raise ValueError(
+            f"shard_activation({var.name!r}): var is a "
+            f"{'persistable' if var.persistable else 'data feed'} — "
+            f"use shard_parameter / data_parallel for those")
+    var.partition_spec = spec
+    try:
+        # the Executor caches the activation-annotation map per program
+        # version; annotating after a compile must refresh it
+        var.block.program._act_shard_cache = None
+    except AttributeError:
+        pass
+    return var
 
 
 def replicate(var):

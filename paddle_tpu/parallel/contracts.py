@@ -1,0 +1,65 @@
+"""Canned CommContracts for the training invariants this package
+establishes (docs/parallel.md) — the machine-checked form of the prose
+rules, shipped next to the code whose placement discipline they audit.
+
+``--multichip-selftest`` and the sharding selftest evaluate these
+against ``exe.last_comm_plan`` instead of hand-rolled reduce-count
+asserts; attach them to a program (``analysis.comm.attach_comm_contract``)
+and every compile's ``hlo.comm-contract`` check enforces them in CI.
+"""
+
+from ..analysis.comm import CommContract
+from .mesh import axis_size
+
+__all__ = ["one_boundary_reduce_contract", "fsdp_scan_contract",
+           "training_step_contract"]
+
+
+def one_boundary_reduce_contract(mesh=None, axis="dp"):
+    """The comm-aware accumulation invariant (docs/parallel.md "The
+    communication audit"): ZERO reduce-class collectives inside loop
+    bodies — a gradient must never be cross-chip-reduced once per
+    microbatch — and at least one boundary-level reduce over ``axis``
+    (the per-optimizer-step gradient aggregation).  ``mesh`` sharpens
+    the expect to the named axis when it exists; without one the
+    boundary reduce is expected axis-unattributed."""
+    c = CommContract("one-boundary-reduce")
+    c.forbid(kind="reduce", in_loop=True)
+    expect_axis = axis if (mesh is None or axis_size(mesh, axis) > 1) \
+        else None
+    c.expect(kind="reduce", axis=expect_axis, min_count=1,
+             in_loop=False, phase="boundary")
+    return c
+
+
+def fsdp_scan_contract(mesh=None):
+    """The FSDP placement invariant (docs/parallel.md "Where the
+    collectives land"): per-layer weight all-gathers over ``fsdp``
+    execute INSIDE the scan loop (that is the design — live gathered
+    bytes stay O(one layer)), while reduce-class collectives stay out
+    of every loop body.  Composes with
+    :func:`one_boundary_reduce_contract` for the full training-step
+    audit."""
+    c = CommContract("fsdp-scan-gathers")
+    c.expect(kind="all-gather", axis="fsdp", min_count=1, in_loop=True)
+    c.forbid(kind="reduce", in_loop=True)
+    return c
+
+
+def training_step_contract(mesh, accum=False, fsdp=False):
+    """The full audited comm shape of one training step on ``mesh``:
+    one boundary gradient reduction over ``dp`` (when the mesh has a
+    dp axis of size > 1), zero in-loop reduces, and — with ``fsdp`` —
+    the in-loop weight gathers FSDP exists to place there.  Returns a
+    list of contracts to attach."""
+    out = []
+    if axis_size(mesh, "dp") > 1:
+        out.append(one_boundary_reduce_contract(mesh))
+    elif accum or axis_size(mesh, "fsdp") > 1:
+        # no dp axis to reduce over, but the in-loop discipline holds
+        c = CommContract("no-inloop-reduce")
+        c.forbid(kind="reduce", in_loop=True)
+        out.append(c)
+    if fsdp and axis_size(mesh, "fsdp") > 1:
+        out.append(fsdp_scan_contract(mesh))
+    return out

@@ -575,7 +575,7 @@ class ServingEngine:
         fixed shapes (bucketed prefill, the decode chunk), so the AOT
         executable serves all of them.  Backends without AOT fall back
         to the plain jit callable."""
-        from ..analysis import compiled_memory_stats
+        from ..analysis.hlo_tools import compiled_memory_stats
 
         box = {}
 

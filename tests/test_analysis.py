@@ -211,7 +211,9 @@ def test_kernel_residual_offload_degraded():
     rep = analysis.lint(main, fetch_list=[loss], levels=("jaxpr",))
     kr = rep.by_check("jaxpr.kernel-residual")
     assert kr and kr[0].severity == "warning"
-    assert analysis.BLOCK_INPUT_TAG in kr[0].message
+    from paddle_tpu.analysis.jaxpr_tools import BLOCK_INPUT_TAG
+
+    assert BLOCK_INPUT_TAG in kr[0].message
 
 
 def test_kernel_residual_quiet_on_clean_offload():
@@ -278,7 +280,9 @@ ENTRY %main.4 (a: f32[8]) -> f32[8] {
 
 
 def test_inloop_collective_error_and_expected():
-    comm = analysis.hlo_comm_report(_INLOOP_HLO)
+    from paddle_tpu.analysis.hlo_tools import hlo_comm_report
+
+    comm = hlo_comm_report(_INLOOP_HLO)
     assert comm["reduce_ops_in_loop"] == 1 and comm["reduce_ops"] == 2
     ctx = analysis.CheckContext(None).seed("comm", comm)
     from paddle_tpu.analysis.hlo_checks import inloop_collective
@@ -367,51 +371,24 @@ def test_artifact_failure_reported_not_raised():
     assert art and all(f.severity == "info" for f in art)
 
 
-# -- compatibility shims ----------------------------------------------------
+# -- the retired memaudit shim surface --------------------------------------
 
-def test_memaudit_shims_delegate_with_deprecation():
-    from paddle_tpu.core import memaudit
-
-    text = _INLOOP_HLO
-    memaudit._warned.discard("hlo_comm_report")
-    with pytest.deprecated_call():
-        old = memaudit.hlo_comm_report(text)
-    assert old == analysis.hlo_comm_report(text)
-    assert memaudit.KERNEL_RESIDUAL_TAG == analysis.KERNEL_RESIDUAL_TAG
-    assert memaudit.BLOCK_INPUT_TAG == analysis.BLOCK_INPUT_TAG
-    assert memaudit.REDUCE_COLLECTIVES == analysis.REDUCE_COLLECTIVES
-
-
-def test_memaudit_shims_warn_exactly_once():
-    """Each shim function emits EXACTLY one DeprecationWarning per
-    process, however many times it is called — the PR-6 contract.  (No
-    in-repo caller imports the shims anymore; this pins the behavior
-    for external callers.)"""
-    import warnings
-
-    from paddle_tpu.core import memaudit
-
-    text = _INLOOP_HLO
-    memaudit._warned.discard("hlo_comm_report")
-    with warnings.catch_warnings(record=True) as rec:
-        warnings.simplefilter("always")
-        memaudit.hlo_comm_report(text)
-        memaudit.hlo_comm_report(text)
-        memaudit.hlo_comm_report(text)
-    dep = [w for w in rec if issubclass(w.category, DeprecationWarning)
-           and "memaudit" in str(w.message)]
-    assert len(dep) == 1, [str(w.message) for w in rec]
-
-
-def test_no_in_repo_memaudit_shim_callers():
-    """The deprecated ``core.memaudit`` shims have zero remaining
-    in-repo importers (ISSUE 11 satellite): everything routes through
-    ``paddle_tpu.analysis`` directly, so the shim file is the ONLY
-    place the module name appears in an import statement."""
+def test_memaudit_shims_deleted():
+    """The deprecated ``core/memaudit.py`` shim module is GONE (ISSUE 14
+    satellite — PR 11 had already migrated every in-repo caller): the
+    module neither exists on disk nor imports, and no in-repo file
+    mentions it in an import statement.  The analysis package no longer
+    re-exports its parity surface either — tools import from
+    ``analysis.hlo_tools`` / ``analysis.jaxpr_tools`` directly."""
+    import importlib
     import re
 
+    with pytest.raises(ImportError):
+        importlib.import_module("paddle_tpu.core.memaudit")
     root = os.path.dirname(os.path.dirname(os.path.abspath(
         pt.__file__)))
+    assert not os.path.exists(os.path.join(
+        root, "paddle_tpu", "core", "memaudit.py"))
     offenders = []
     for dirpath, _dirs, files in os.walk(root):
         if any(part in dirpath for part in
@@ -422,27 +399,33 @@ def test_no_in_repo_memaudit_shim_callers():
             if not fn.endswith(".py"):
                 continue
             path = os.path.join(dirpath, fn)
-            if path.endswith("core/memaudit.py") or fn == "test_analysis.py":
-                continue  # the shim itself + its contract tests
+            if fn == "test_analysis.py":
+                continue  # this contract test
             src = open(path, "r", encoding="utf-8",
                        errors="ignore").read()
             if re.search(r"^\s*(from|import)\s+[\w.]*memaudit",
                          src, re.MULTILINE):
                 offenders.append(os.path.relpath(path, root))
     assert not offenders, offenders
+    # the memaudit-parity names no longer ride the package namespace
+    for gone in ("hlo_comm_report", "comm_report",
+                 "compiled_memory_stats", "jaxpr_report", "walk_report",
+                 "KERNEL_RESIDUAL_TAG", "BLOCK_INPUT_TAG",
+                 "REDUCE_COLLECTIVES", "shape_pattern"):
+        assert not hasattr(analysis, gone), gone
 
 
-def test_memaudit_audit_program_shim():
-    from paddle_tpu.core.memaudit import audit_program
-
+def test_audit_program_entry_point():
+    """``analysis.audit_program`` (the real PR-4 audit entry point, not
+    a shim) keeps its contract after the shim deletion."""
     main, startup, loss = _small_gpt("selective")
     scope = pt.Scope()
     with pt.core.scope.scope_guard(scope):
         exe = pt.Executor()
         exe.run(startup, scope=scope)
-        rep = audit_program(main, _feed(), [loss], scope=scope,
-                            layer_count=N_LAYER,
-                            absent_shapes=[(N_LAYER, T, D)])
+        rep = analysis.audit_program(main, _feed(), [loss], scope=scope,
+                                     layer_count=N_LAYER,
+                                     absent_shapes=[(N_LAYER, T, D)])
     assert rep["pallas_total"] > 0
     assert not rep["layer_stacked_pallas"]
     assert rep["temp_bytes"] > 0 and rep["hbm_high_water_bytes"] > 0

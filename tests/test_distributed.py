@@ -520,6 +520,17 @@ def test_multihost_two_process_cpu(tmp_path):
         for p in procs:
             out, _ = p.communicate(timeout=300)
             outs.append(out)
+        if any("Multiprocess computations aren't implemented" in out
+               for out in outs):
+            # this image's jaxlib CPU backend cannot execute
+            # cross-process computations at all (the PJRT CPU client
+            # raises UNIMPLEMENTED on the first collective) — the same
+            # environment limitation test_multihost_midpass_kill_resume
+            # already skips on.  Nothing in-repo can fix a jaxlib
+            # build; ROADMAP item 4c tracks running this gate on a
+            # capable jaxlib.
+            pytest.skip("this jaxlib's CPU backend cannot run "
+                        "cross-process computations")
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"rank {i} failed:\n{out}"
         oks = [
@@ -847,7 +858,16 @@ def test_multihost_sharded_checkpoint_resume(tmp_path):
     uninterrupted 3-step run on both ranks."""
     env = _multihost_env(2)
     ckpt = tmp_path / "ckpt"
-    ref = _run_multihost_phase("ckpt_ref", ckpt, env)
+    try:
+        ref = _run_multihost_phase("ckpt_ref", ckpt, env)
+    except AssertionError as e:
+        # same jaxlib limitation as test_multihost_two_process_cpu /
+        # test_multihost_midpass_kill_resume: the CPU PJRT client
+        # raises UNIMPLEMENTED on any cross-process computation
+        if "Multiprocess computations aren't implemented" in str(e):
+            pytest.skip("this jaxlib's CPU backend cannot run "
+                        "cross-process computations")
+        raise
     saved = _run_multihost_phase("ckpt_save", ckpt, env)
     # the checkpoint really is per-process shard files
     files = os.listdir(ckpt)

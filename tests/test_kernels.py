@@ -250,6 +250,37 @@ def test_global_env_fallback_to_auto(monkeypatch):
     assert resolve_name("decode_gather") in ("pallas_tpu", "xla_ref")
 
 
+def test_global_env_fallback_counted_once_per_resolution(monkeypatch):
+    """The degrade-to-auto path's accounting contract (ISSUE 14
+    satellite): a global env pin an op cannot serve increments
+    ``kernels.env_fallbacks`` EXACTLY once per resolution — no double
+    count inside one resolve, no missed count across repeats — while a
+    servable pin and a strict (raising) explicit request increment
+    nothing."""
+    from paddle_tpu.observability import get_registry
+
+    reg = get_registry()
+
+    def count():
+        return int(reg.value("kernels.env_fallbacks") or 0)
+
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_BACKEND", "triton")
+    c0 = count()
+    assert resolve_name("decode_gather") in ("pallas_tpu", "xla_ref")
+    assert count() == c0 + 1
+    assert resolve_name("decode_gather") in ("pallas_tpu", "xla_ref")
+    assert count() == c0 + 2
+    # a pin the op CAN serve resolves directly: no fallback counted
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_BACKEND", "xla_ref")
+    assert resolve_name("decode_gather") == "xla_ref"
+    assert count() == c0 + 2
+    # strict sources raise instead of degrading: still no count
+    monkeypatch.delenv("PADDLE_TPU_KERNEL_BACKEND")
+    with pytest.raises(KernelUnavailable):
+        resolve_name("decode_gather", "triton")
+    assert count() == c0 + 2
+
+
 def test_forced_backend_scopes_and_restores():
     before = resolve_name("fused_ce")
     with forced_backend("xla_ref"):

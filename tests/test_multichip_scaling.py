@@ -15,7 +15,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import paddle_tpu as pt
 from paddle_tpu import layers
-from paddle_tpu.analysis import hlo_comm_report
+from paddle_tpu.analysis.hlo_tools import hlo_comm_report
 from paddle_tpu.core.scope import RNG_VAR
 from paddle_tpu.models import transformer
 from paddle_tpu.parallel import api as papi

@@ -65,6 +65,21 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_linttest.log >&2
     exit 1
 fi
+# sharding/comm-contract smoke: the communication contract analyzer —
+# three planted constraint-placement violations (symmetric fsdp pin,
+# fsdp-composed grad carry, forbidden activation reshard) each caught
+# with the right kind/axis/loop attribution, CommPlan mesh-axis
+# recovery + comm_diff, and the clean-GPT sweep (every memory_optimize
+# policy x FSDP on/off x ZeRO on/off on the 8-device CPU mesh)
+# reporting zero error-severity comm findings under the attached
+# training contracts (docs/analysis.md "Communication contracts")
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --sharding-selftest \
+        > /tmp/_t1_sharding.log 2>&1; then
+    echo "TIER1 REGRESSION: sharding selftest failed" >&2
+    cat /tmp/_t1_sharding.log >&2
+    exit 1
+fi
 # tracing smoke: the end-to-end tracing engine — span runtime semantics,
 # the trainer's five step-phase spans into a valid Chrome-trace file,
 # the serving request span tree's TTFT decomposition (queue + prefill
