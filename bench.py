@@ -128,12 +128,17 @@ def _fold_attribution(exe, extra, prefix, measured_step_s=None):
         extra[prefix + "attribution"] = {
             "classes": {
                 c: {k: r.get(k) for k in
-                    ("flops", "bytes", "est_ms", "share", "bound")}
+                    ("flops", "bytes", "ops", "est_ms", "share", "bound")}
                 for c, r in att.get("classes", {}).items()},
             "workload": att.get("workload"),
             "coverage": att.get("coverage"),
             "est_ms_total": att.get("est_ms_total"),
         }
+        # which model priced est_ms: fitted coefficients or the analytic
+        # roofline (tune/costmodel.py) — a trajectory of err_pct is only
+        # comparable within one mode
+        if att.get("costmodel"):
+            extra[prefix + "costmodel"] = att.get("costmodel")
         rec = _attr.reconcile(att, measured_step_s)
         if rec:
             extra[prefix + "attr_model_err_pct"] = rec["err_pct"]

@@ -1402,6 +1402,25 @@ def cmd_tune_selftest(args=None):
     return run_selftest()
 
 
+def cmd_costmodel_selftest(args=None):
+    """``python -m paddle_tpu --costmodel-selftest``: the learned cost
+    model's CI gate (docs/observability.md "Cost model calibration") —
+    two real CPU-measured toy-GPT runs seed the measurement corpus
+    through the production MetricsReporter JSONL path (plus a bench
+    artifact and a classified non-object artifact), the fitted
+    roofline's holdout error must STRICTLY improve on the analytic
+    model's recorded error over the same held-out rows, the t=16k
+    flagship static prune under the fitted model still rejects the
+    known-OOM BENCH_r05 config and selects the same known-good
+    schedule, a corrupt/truncated/schema-mismatched model file each
+    degrades cleanly to the analytic defaults, and
+    ``PADDLE_TPU_COSTMODEL=0`` reproduces the no-model estimates
+    bit-exact.  Wired into tools/tier1.sh."""
+    from .tune.costmodel_selftest import run_selftest
+
+    return run_selftest()
+
+
 def cmd_kernels_selftest(args=None):
     """``python -m paddle_tpu --kernels-selftest``: the multi-backend
     kernel registry's CI gate (docs/kernels.md) — registry resolution
@@ -1474,6 +1493,8 @@ def main(argv=None):
         return cmd_tune_selftest()
     if "--kernels-selftest" in argv:
         return cmd_kernels_selftest()
+    if "--costmodel-selftest" in argv:
+        return cmd_costmodel_selftest()
     if "--attribution-selftest" in argv:
         return cmd_attribution_selftest()
     if "--bench-history" in argv:

@@ -742,6 +742,17 @@ class Executor:
                     cost["attribution"] = _attr.summarize(att)
         except Exception:  # noqa: BLE001 — telemetry must never block
             pass
+        try:
+            # learned cost model status (tune/costmodel.py): whether the
+            # attribution estimates above came from the FITTED
+            # coefficients or the analytic defaults — rides into trainer
+            # JSONL and flight bundles so a corpus row says which model
+            # produced its est_ms
+            from ..tune.costmodel import model_status
+
+            cost["costmodel"] = model_status()
+        except Exception:  # noqa: BLE001 — telemetry must never block
+            pass
         from ..analysis import compile_findings, lint_enabled
 
         if program is not None and lint_enabled():

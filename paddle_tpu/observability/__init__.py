@@ -26,6 +26,10 @@ Modules:
 * ``attribution`` — per-op-class performance attribution over every
   compiled step's HLO (flops/bytes/roofline ms per class,
   ``exe.last_attribution``; the learned-cost-model corpus);
+* ``corpus`` — the cross-run measurement store: trainer JSONL, bench/
+  multichip artifacts and tune-cache measured candidates read back
+  into one row shape the learned cost model (``tune/costmodel.py``)
+  fits on — malformed rows classified, never crashed;
 * ``flight`` — the crash flight recorder: a bounded ring of recent
   step records dumped as one post-mortem JSON bundle on watchdog /
   NaN / OOM / driver-death / trainer-exception trips.
@@ -41,10 +45,11 @@ Quick start::
 """
 
 from . import (
-    attribution, bench_history, flight, hardware, metrics, reporter,
-    runlog, trace,
+    attribution, bench_history, corpus, flight, hardware, metrics,
+    reporter, runlog, trace,
 )
 from .bench_history import run_stamp
+from .corpus import Corpus
 from .flight import FlightRecorder, get_recorder, set_recorder
 from .hardware import (
     device_memory_stats, device_peak_flops, mfu, sample_memory,
@@ -60,7 +65,7 @@ from .trace import Tracer, get_tracer, set_tracer
 
 __all__ = [
     "metrics", "runlog", "hardware", "reporter", "trace", "bench_history",
-    "attribution", "flight",
+    "attribution", "flight", "corpus", "Corpus",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "start_metrics_server", "RunLog", "read_jsonl", "MetricsReporter",
     "device_peak_flops", "total_peak_flops", "mfu",

@@ -56,7 +56,7 @@ from . import metrics as _obs
 __all__ = [
     "SCHEMA_VERSION", "attribution_enabled", "attribute_hlo",
     "attribute_compiled", "summarize", "reconcile", "share_table",
-    "program_workload_key",
+    "program_workload_key", "normalize_workload_key",
 ]
 
 SCHEMA_VERSION = 1
@@ -308,17 +308,54 @@ def attribute_hlo(text, peak_flops=None, hbm_bw=None):
     return att
 
 
+def _fitted_costmodel():
+    """``(entry, status)`` of the fitted cost model for the current
+    platform (``tune/costmodel.py``), or ``(None, {"mode":
+    "analytic"})`` — None when the ``PADDLE_TPU_COSTMODEL=0`` kill
+    switch is set, no fit covers this platform, or the tune package is
+    unavailable mid-bootstrap.  A None entry means the analytic
+    roofline in :func:`_finalize_roofline` runs exactly as before the
+    learned model existed, bit-exact."""
+    try:
+        from ..tune import costmodel as _cm
+
+        entry = _cm.active_entry()
+        if entry is None:
+            return None, {"mode": "analytic"}
+        return entry, _cm.model_status()
+    except Exception:  # noqa: BLE001 — the consult must never break a walk
+        return None, {"mode": "analytic"}
+
+
 def _finalize_roofline(att):
     """(Re)compute the per-class roofline estimates, bound verdicts and
     shares plus the flop/est totals from the classes' flops/bytes —
     called by :func:`attribute_hlo` and AGAIN by
     :func:`attribute_compiled` after an opaque kernel's flop estimate
     is patched in (the shares must reflect the kernel's math, or a
-    flash slowdown on TPU would never move the pallas share)."""
+    flash slowdown on TPU would never move the pallas share).
+
+    When a FITTED cost model is loadable (``tune/costmodel.py``), each
+    class's estimate comes from the calibrated per-class coefficients
+    instead of the analytic ``max(flops/peak, bytes/bw)`` — the bound
+    verdict then compares the fitted compute vs memory terms.  The
+    model status rides on ``att["costmodel"]`` either way."""
     classes = att["classes"]
     peak_flops, hbm_bw = att["peak_flops"], att["hbm_bw"]
+    entry, status = _fitted_costmodel()
+    att["costmodel"] = status
+    if entry is not None:
+        from ..tune import costmodel as _cm
     total_est = 0.0
-    for row in classes.values():
+    for cls, row in classes.items():
+        if entry is not None:
+            est_ms, compute_ms, mem_ms = _cm.predict_class_ms(
+                entry, cls, row["flops"], row["bytes"], row["ops"])
+            row["est_ms"] = est_ms
+            row["bound"] = ("compute" if compute_ms >= mem_ms
+                            else "memory")
+            total_est += row["est_ms"]
+            continue
         compute_s = row["flops"] / peak_flops if peak_flops else 0.0
         mem_s = row["bytes"] / hbm_bw if hbm_bw else 0.0
         row["est_ms"] = max(compute_s, mem_s) * 1e3
@@ -399,6 +436,20 @@ def program_workload_key(program, remat=None):
         return WorkloadKey("step", t, d_head, n_head, var.dtype,
                            platform, remat=pol, backend=kb).s
     return None
+
+
+def normalize_workload_key(key):
+    """Canonicalize a workload-key string for corpus joins: keys
+    written before the kernel registry existed (pre-PR-13 JSONL) carry
+    no ``|kb=`` backend token — backfill ``|kb=unknown`` so
+    mixed-vintage corpora join on one key shape instead of the old
+    rows being silently skipped.  Non-key strings and None pass
+    through unchanged (None stays None)."""
+    if not isinstance(key, str) or not key.startswith("op="):
+        return key if key else None
+    if "|kb=" in key:
+        return key
+    return key + "|kb=unknown"
 
 
 def _flash_estimate(program, n_calls):
@@ -491,7 +542,10 @@ def share_table(att):
 def summarize(att, top_n=3):
     """The compact summary folded into ``last_step_cost["attribution"]``
     (and thence trainer JSONL / bench rows): the top-``top_n`` classes
-    by estimated time plus the totals the reconciliation needs."""
+    by estimated time plus the totals the reconciliation needs, the
+    compact per-class ``[flops, bytes, ops, est_ms]`` table a corpus
+    row fits on (``observability/corpus.py``), and the cost-model
+    status (fitted vs analytic) the estimates were computed under."""
     if not att:
         return None
     rows = sorted(att.get("classes", {}).items(),
@@ -502,6 +556,10 @@ def summarize(att, top_n=3):
         "est_ms_total": att.get("est_ms_total"),
         "coverage": att.get("coverage"),
         "workload": att.get("workload"),
+        "classes": {c: [r.get("flops"), r.get("bytes"), r.get("ops"),
+                        r.get("est_ms")]
+                    for c, r in att.get("classes", {}).items()},
+        "costmodel": att.get("costmodel"),
     }
 
 
@@ -518,8 +576,15 @@ def reconcile(att, measured_step_s):
     if est_ms is None:
         return None
     measured_ms = measured_step_s * 1e3
-    return {
+    out = {
         "est_ms": round(est_ms, 6),
         "measured_ms": round(measured_ms, 6),
         "err_pct": round((est_ms - measured_ms) / measured_ms * 100.0, 2),
     }
+    # the corpus join key, NORMALIZED: pre-PR-13 records whose key lacks
+    # the |kb= backend token used to be silently unjoinable — backfill
+    # backend=unknown so mixed-vintage corpora reconcile (one row shape)
+    wk = normalize_workload_key(att.get("workload"))
+    if wk:
+        out["workload"] = wk
+    return out

@@ -16,10 +16,12 @@ Classification (``classify_artifact``) marks an artifact FAILED when its
 required keys (``metric``/``value``) — each with a reason string.
 
 Regression detection (``history``) builds one trajectory per tracked
-metric (all are higher-is-better: img/s, tok/s, MFU, plus serving
-tok/s/speedup when the driver runs bench.py with ``BENCH_SERVING=1``)
-ordered by round and flags any value more than ``threshold`` (default
-10%) below the best seen so far; multichip ``scaling_efficiency``
+metric (higher-is-better: img/s, tok/s, MFU, plus serving tok/s/speedup
+when the driver runs bench.py with ``BENCH_SERVING=1``; the
+``_LOWER_IS_BETTER`` family — cost-model error ``gpt_attr_model_err_pct``
+— inverts the direction) ordered by round and flags any value more than
+``threshold`` (default 10%) below the best seen so far (above, for the
+lower-is-better family); multichip ``scaling_efficiency``
 shows in the trajectory but is exempt from flagging (virtual-CPU-mesh
 step times are indicative only).  Known,
 root-caused failures are acknowledged via a JSON file
@@ -71,6 +73,19 @@ _EXTRA_METRICS = (
     "gpt_tokens_per_sec_per_chip", "gpt_mfu", "gate_flagship_gpt_seq",
     "gpt_t16k_tune_tok_s",
 )
+# first-class LOWER-is-better trajectory metrics, each with the reason
+# it tracks in this direction (the _REGRESSION_EXEMPT discipline:
+# documented, not hardcoded).  Flagging inverts: a value more than
+# ``threshold`` ABOVE the best (lowest) seen so far is a regression.
+_LOWER_IS_BETTER = {
+    # |roofline est - measured| / measured of the GPT step: the learned
+    # cost model (tune/costmodel.py) exists to drive this DOWN, so the
+    # trajectory must flag when model error WORSENS >10% vs best-so-far
+    # — a silently decaying cost model mis-prunes every later search
+    "gpt_attr_model_err_pct":
+        "cost-model error: lower is better; tracked as |err| so the "
+        "fitted model's drift vs best-so-far gates in CI",
+}
 _MULTICHIP_METRICS = ("scaling_efficiency", "param_bytes_per_device")
 _SERVING_METRICS = ("tok_s", "speedup", "goodput_under_slo",
                     "prefix_hit_rate")
@@ -250,6 +265,13 @@ def classify_artifact(path):
                 if isinstance(v, (int, float)) and not isinstance(
                         v, bool):
                     row["metrics"][k] = float(v)
+            for k in _LOWER_IS_BETTER:
+                v = extra.get(k)
+                if isinstance(v, (int, float)) and not isinstance(
+                        v, bool):
+                    # err_pct is SIGNED (negative = underestimate);
+                    # model quality is its magnitude
+                    row["metrics"][k] = abs(float(v))
             for k in _SERVING_METRICS:
                 v = extra.get(f"serving_{k}")
                 if isinstance(v, (int, float)):
@@ -323,16 +345,28 @@ def history(root, threshold=0.1, known_failures=None):
     for metric, points in sorted(series.items()):
         if metric in _REGRESSION_EXEMPT:
             continue
+        lower = metric in _LOWER_IS_BETTER
         best, best_at, best_artifact = None, None, None
         for rnd, artifact, value in points:
-            if best is not None and value < best * (1.0 - threshold):
-                regressions.append({
+            if lower:
+                # lower-is-better (cost-model error): flag a value more
+                # than threshold ABOVE the best (lowest) seen so far
+                worse = (best is not None and best > 0
+                         and value > best * (1.0 + threshold))
+            else:
+                worse = (best is not None
+                         and value < best * (1.0 - threshold))
+            if worse:
+                entry = {
                     "metric": metric, "round": rnd, "artifact": artifact,
                     "value": value, "best": best, "best_round": best_at,
                     "best_artifact": best_artifact,
-                    "drop": round(1.0 - value / best, 4),
-                })
-            if best is None or value > best:
+                    "drop": round(abs(1.0 - value / best), 4),
+                }
+                if lower:
+                    entry["direction"] = "lower_is_better"
+                regressions.append(entry)
+            if best is None or (value < best if lower else value > best):
                 best, best_at, best_artifact = value, rnd, artifact
     # ATTRIBUTE each flagged regression: diff the regressed artifact's
     # per-op-class share table against the best round's and name the

@@ -7,8 +7,9 @@ serving driver, an uncaught trainer exception — the JSONL may be
 unflushed, the spans live only in memory, and the operator gets a stack
 trace with no history.  The flight recorder keeps a bounded ring of the
 last N step records (phase durations, loss, grad norm, HBM high-water,
-collective bytes, lint/tune counters — whatever the caller records) and
-on a trip dumps ONE bundle::
+collective bytes, the compile's ``comm_plan`` bucket summary, the
+``costmodel`` fitted/analytic status, lint/tune counters — whatever the
+caller records) and on a trip dumps ONE bundle::
 
     {"schema_version": 1, "reason": "nan_trip", "ts": ..., "pid": ...,
      "context": {...},            # trip-specific (loss, error, age_s)

@@ -52,7 +52,17 @@ class MetricsReporter:
 
         self._loss_window = collections.deque(maxlen=64)
         if self.runlog is not None:
-            self.runlog.log("run_meta", **(run_meta or {}))
+            # the run identity stamp (schema_version/run_id/git_sha —
+            # bench_history.run_stamp) rides the run_meta record so the
+            # measurement corpus (observability/corpus.py) can dedup and
+            # attribute this file's step rows; caller meta wins on clash
+            try:
+                from .bench_history import run_stamp
+
+                meta = {**run_stamp(), **(run_meta or {})}
+            except Exception:  # noqa: BLE001 — identity never blocks
+                meta = dict(run_meta or {})
+            self.runlog.log("run_meta", **meta)
 
     # -- composition -------------------------------------------------------
     def chain(self, handler):
@@ -180,6 +190,13 @@ class MetricsReporter:
                 attr_coverage=att.get("coverage"),
                 attr_workload=att.get("workload"),
                 attr_model_err_pct=attr_err,
+                # compact per-class [flops, bytes, ops, est_ms] table —
+                # the features one learned-cost-model corpus row fits on
+                # (observability/corpus.py ingests these back)
+                attr_classes=att.get("classes"),
+                # whether the estimates above came from the FITTED cost
+                # model or the analytic defaults (tune/costmodel.py)
+                costmodel=sc.get("costmodel"),
                 # which kernel-registry backend each op class of the
                 # compiled step resolved to (docs/kernels.md) — the
                 # attr_workload |kb= token carries the flash choice;

@@ -482,6 +482,13 @@ class Trainer:
             lint_errors=sc.get("lint_errors"),
             tune=sc.get("tune"),
             attr_est_ms=att.get("est_ms_total"),
+            # the compile's structured comm-plan bucket summary
+            # (analysis.comm, PR 14) and the cost-model status
+            # (tune/costmodel.py): both postdate the original bundle
+            # schema — a post-mortem should say which collectives the
+            # dying step was scheduled to run and which model priced it
+            comm_plan=sc.get("comm_plan"),
+            costmodel=sc.get("costmodel"),
             **phases)
         import math
 

@@ -39,6 +39,10 @@ from ..observability import metrics as _obs
 from .cache import (
     CACHE_SCHEMA_VERSION, TuneCache, cache_path, geometry_fingerprint,
     get_cache, reset_cache)
+from .costmodel import (
+    COSTMODEL_SCHEMA_VERSION, CostModel, costmodel_enabled,
+    costmodel_path, fit_and_save, fit_cost_model, get_model,
+    model_status, reset_model)
 from .space import (
     POLICY_ORDER, WorkloadKey, attention_candidates,
     estimate_gpt_step_hbm, prune_static, schedule_candidates,
@@ -56,6 +60,9 @@ __all__ = [
     "flagship_static_demo", "tune_gpt_step", "tune_serving_decode",
     "tune_mode", "attention_config", "schedule_config_for",
     "serving_decode_config", "forced_attention_config", "tune_stats",
+    "COSTMODEL_SCHEMA_VERSION", "CostModel", "costmodel_enabled",
+    "costmodel_path", "fit_and_save", "fit_cost_model", "get_model",
+    "model_status", "reset_model",
 ]
 
 

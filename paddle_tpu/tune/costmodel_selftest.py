@@ -1,0 +1,343 @@
+"""``python -m paddle_tpu --costmodel-selftest`` — the learned cost
+model's CI gate, CPU-only (wired into tools/tier1.sh).
+
+The whole observability->tuning loop proves itself off-accelerator:
+
+1. SEED: two real CPU-measured toy-GPT runs (different sequence
+   lengths) stream through the production ``MetricsReporter`` into
+   trainer JSONL; the corpus ingests them plus a bench-artifact
+   fixture built from a real attribution table, classifying (not
+   crashing on) a planted non-object artifact.
+2. FIT: ``fit_and_save`` on that corpus; the fitted holdout error must
+   STRICTLY improve on the analytic roofline's recorded error over the
+   same held-out rows (on CPU the analytic model underestimates wall
+   time by ~100x — the fitted per-step constant closes it).
+3. CONSULT: a fresh compile records ``costmodel: fitted`` in
+   ``last_step_cost`` and its trainer JSONL rows; the t=16k flagship
+   static prune still REJECTS the known-OOM BENCH_r05 config and
+   selects the SAME known-good schedule as the analytic model
+   (``predict_sched_ms`` is monotonic in flops — ordering preserved).
+4. ROBUSTNESS: a corrupt, truncated, or schema-mismatched model file
+   each degrades to the analytic defaults (``tune.costmodel_errors``
+   counts, ``attribute_hlo`` stays bit-exact to the no-model baseline).
+5. KILL SWITCH: ``PADDLE_TPU_COSTMODEL=0`` with a VALID fitted file on
+   disk reproduces the no-model estimates bit-exact — the attribution
+   table's floats, ``estimate_gpt_step_hbm``'s ints and the full
+   flagship static demo.
+"""
+
+import json
+import os
+import tempfile
+import time
+
+__all__ = ["run_selftest"]
+
+_TOY = dict(vocab=61, n_layer=3, n_head=2, d_model=64, batch=4,
+            dtype="float32")
+
+# a synthetic-but-wellformed optimized-HLO module: the pure-function
+# currency for the bit-exactness checks (one dot, one fusion whose body
+# op carries flops but no bytes, one reduce — three distinct op classes)
+_TOY_HLO = """\
+HloModule costmodel_selftest
+
+%fused_add (a: f32[64,64], b: f32[64,64]) -> f32[64,64] {
+  %a = f32[64,64] parameter(0)
+  %b = f32[64,64] parameter(1)
+  ROOT %add.9 = f32[64,64] add(%a, %b)
+}
+
+ENTRY %main (p0: f32[64,64], p1: f32[64,64]) -> f32[64] {
+  %p0 = f32[64,64] parameter(0)
+  %p1 = f32[64,64] parameter(1)
+  %dot.1 = f32[64,64] dot(%p0, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %fusion.2 = f32[64,64] fusion(%dot.1, %p1), kind=kLoop, calls=%fused_add
+  ROOT %reduce.3 = f32[64] reduce(%fusion.2, %p1), dimensions={1}
+}
+"""
+
+
+class EndIteration:
+    """Duck-typed trainer event (reporter dispatches on the class
+    NAME) — the selftest synthesizes the step stream so the production
+    MetricsReporter writes genuine JSONL from real measured walls and
+    real compiled cost dicts, without trainer scaffolding."""
+
+    def __init__(self, pass_id, batch_id, cost, wall_time, step_cost,
+                 samples):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.wall_time = wall_time
+        self.step_cost = step_cost
+        self.samples = samples
+        self.throughput = samples / wall_time if wall_time else None
+        self.mfu = None
+        self.reader_wait = None
+        self.grad_norm = None
+
+
+def _build_toy(seq_len):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    pt.core.unique_name.reset()
+    main_prog, startup = pt.Program(), pt.Program()
+    main_prog.random_seed = 7
+    with pt.program_guard(main_prog, startup):
+        outs = transformer.build(
+            vocab_size=_TOY["vocab"], n_layer=_TOY["n_layer"],
+            n_head=_TOY["n_head"], d_model=_TOY["d_model"],
+            max_len=seq_len, dropout_rate=0.0, dtype=_TOY["dtype"],
+            fused_head=True)
+        pt.memory_optimize(main_prog, policy="selective")
+    return main_prog, startup, outs
+
+
+def _measured_run(seq_len, steps, jsonl_path, run_id):
+    """One real toy-GPT run: compile + ``steps`` measured steps, each
+    streamed through a production MetricsReporter into ``jsonl_path``.
+    Returns the compile's attribution table and last_step_cost."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.observability import MetricsReporter
+
+    main_prog, startup, outs = _build_toy(seq_len)
+    rng = np.random.default_rng(seq_len)
+    toks = rng.integers(0, _TOY["vocab"],
+                        (_TOY["batch"], seq_len)).astype(np.int64)
+    feed = {"tokens": toks, "labels": np.roll(toks, -1, axis=1)}
+    scope = pt.core.scope.Scope()
+    pt.core.scope._scope_stack.append(scope)
+    reporter = MetricsReporter(log_every_n=0, jsonl_path=jsonl_path,
+                               run_meta={"run_id": run_id})
+    try:
+        exe = pt.Executor()
+        exe.run(startup, scope=scope)
+        # warmup pays the compile outside the measured walls
+        exe.run(main_prog, feed=feed, fetch_list=[outs["avg_cost"]],
+                scope=scope)
+        for i in range(steps):
+            t0 = time.perf_counter()
+            loss = exe.run(main_prog, feed=feed,
+                           fetch_list=[outs["avg_cost"]], scope=scope)[0]
+            wall = time.perf_counter() - t0
+            reporter(EndIteration(0, i, float(np.asarray(loss).ravel()[0]),
+                                  wall, dict(exe.last_step_cost),
+                                  _TOY["batch"]))
+        return exe.last_attribution, dict(exe.last_step_cost)
+    finally:
+        reporter.close()
+        pt.core.scope._scope_stack.pop()
+
+
+def _hbm_points():
+    """The estimate_gpt_step_hbm probe set for the bit-exactness check
+    (flagship dims at t=16k across the policy/accum grid)."""
+    from paddle_tpu.tune.space import estimate_gpt_step_hbm
+
+    return [estimate_gpt_step_hbm(26, 5120, 40, 32000, 16384, 6,
+                                  policy=p, accum=a)
+            for p in ("none", "selective", "compact", "full", "offload")
+            for a in (1, 2)]
+
+
+def run_selftest():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu import tune
+    from paddle_tpu.observability import attribution as attr
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability.corpus import Corpus
+    from paddle_tpu.tune import costmodel as cm
+
+    failures = []
+
+    def check(cond, what):
+        (failures.append(what) if not cond else None)
+        print(("ok   " if cond else "FAIL ") + what)
+
+    tmp = tempfile.mkdtemp(prefix="pt_costmodel_")
+    old_env = {k: os.environ.get(k)
+               for k in ("PADDLE_TPU_TUNE_CACHE", "PADDLE_TPU_COSTMODEL",
+                         "PADDLE_TPU_COSTMODEL_PATH")}
+    os.environ["PADDLE_TPU_TUNE_CACHE"] = os.path.join(tmp, "tuned.json")
+    os.environ.pop("PADDLE_TPU_COSTMODEL", None)
+    os.environ.pop("PADDLE_TPU_COSTMODEL_PATH", None)
+    tune.reset_cache()
+    cm.reset_model()
+    reg = get_registry()
+    try:
+        # -- 0. the analytic baselines (no model file exists) -----------
+        att_base = attr.attribute_hlo(_TOY_HLO)
+        hbm_base = _hbm_points()
+        demo_base = tune.flagship_static_demo()
+        check(att_base.get("costmodel", {}).get("mode") == "analytic",
+              "no model file: attribution runs analytic")
+
+        # -- 1. seed the corpus from real measured GPT-family runs -----
+        run_a = os.path.join(tmp, "run_a.jsonl")
+        run_b = os.path.join(tmp, "run_b.jsonl")
+        att_a, cost_a = _measured_run(128, 6, run_a, "costmodel-run-a")
+        att_b, _cost_b = _measured_run(64, 6, run_b, "costmodel-run-b")
+        check((cost_a.get("costmodel") or {}).get("mode") == "analytic",
+              "pre-fit compile records costmodel: analytic in "
+              "last_step_cost")
+        co = Corpus()
+        n_a = co.ingest_trainer_jsonl(run_a)
+        n_b = co.ingest_trainer_jsonl(run_b)
+        check(n_a == 6 and n_b == 6,
+              f"trainer JSONL ingests every measured step row "
+              f"({n_a} + {n_b})")
+        # a bench artifact built from the real attribution table, the
+        # bench.py _fold_attribution extras shape; its measured time is
+        # run A's median wall so the reconstructed row is a consistent
+        # 13th measurement, not an outlier
+        walls = sorted(r["measured_ms"] for r in co.rows
+                       if r["source"].endswith("run_a.jsonl"))
+        rec = attr.reconcile(att_a, walls[len(walls) // 2] / 1e3)
+        art = os.path.join(tmp, "BENCH_cm01.json")
+        with open(art, "w", encoding="utf-8") as fh:
+            json.dump({"n": 1, "rc": 0, "parsed": {
+                "metric": "gpt_train_tokens_per_sec_per_chip",
+                "value": 1.0, "unit": "tok/s",
+                "extra": {
+                    "gpt_attribution": {
+                        "classes": att_a["classes"],
+                        "workload": att_a.get("workload"),
+                        "est_ms_total": att_a.get("est_ms_total")},
+                    "gpt_attr_est_ms": rec["est_ms"],
+                    "gpt_attr_model_err_pct": rec["err_pct"]}}}, fh)
+        bad = os.path.join(tmp, "BENCH_cm02.json")
+        with open(bad, "w", encoding="utf-8") as fh:
+            fh.write("[1, 2, 3]")
+        check(co.ingest_artifact(art) == 1,
+              "bench artifact's attribution table becomes a corpus row")
+        co.ingest_artifact(bad)
+        check(any("not a JSON object" in reason
+                  for _s, reason in co.skipped),
+              f"non-object artifact classified, not crashed "
+              f"({co.summary()['skip_reasons']})")
+        check(len(co.rows) == 13 and all(
+            r["platform"] == "cpu" for r in co.rows),
+            f"corpus holds 13 cpu rows ({co.summary()})")
+
+        # -- 2. fit: holdout error strictly beats the analytic model ---
+        model = cm.fit_and_save(co)
+        entry = model.entry("cpu")
+        check(entry is not None and entry["train_rows"] >= 8,
+              f"fit produced a cpu entry "
+              f"(train_rows={entry and entry['train_rows']})")
+        fit_err = entry and entry.get("holdout_err_pct")
+        ana_err = entry and entry.get("analytic_err_pct")
+        check(fit_err is not None and ana_err is not None
+              and fit_err < ana_err,
+              f"fitted holdout error strictly improves on the analytic "
+              f"roofline ({fit_err}% < {ana_err}%)")
+        st = cm.model_status()
+        check(st.get("mode") == "fitted"
+              and st.get("train_rows") == entry["train_rows"],
+              f"model_status reports the fit ({st})")
+
+        # -- 3. consult points: fitted estimates + preserved ordering --
+        att_fit, cost_fit = _measured_run(
+            64, 2, os.path.join(tmp, "run_c.jsonl"), "costmodel-run-c")
+        check((cost_fit.get("costmodel") or {}).get("mode") == "fitted",
+              "post-fit compile records costmodel: fitted in "
+              "last_step_cost")
+        with open(os.path.join(tmp, "run_c.jsonl"),
+                  encoding="utf-8") as fh:
+            crows = [json.loads(ln) for ln in fh if ln.strip()]
+        csteps = [r for r in crows if r.get("event") == "step"]
+        check(bool(csteps) and all(
+            (r.get("costmodel") or {}).get("mode") == "fitted"
+            for r in csteps),
+            "trainer JSONL rows carry the fitted costmodel status")
+        att_fit_hlo = attr.attribute_hlo(_TOY_HLO)
+        check(att_fit_hlo["est_ms_total"] != att_base["est_ms_total"],
+              f"fitted model moves the roofline estimates "
+              f"({att_fit_hlo['est_ms_total']} vs analytic "
+              f"{att_base['est_ms_total']} ms)")
+        demo_fit = tune.flagship_static_demo()
+        check("rejected" not in str(demo_fit) or demo_fit.get(
+            "gpt_t16k_rejected_r05_config") is not None,
+            "fitted t16k demo still runs the static prune")
+        check(demo_fit.get("gpt_t16k_rejected_r05_config") is not None,
+              f"fitted model still REJECTS the known-OOM BENCH_r05 "
+              f"config ({demo_fit.get('gpt_t16k_rejected_r05_config')})")
+        same_sel = all(
+            demo_fit.get(k) == demo_base.get(k)
+            for k in ("gpt_t16k_selected_policy",
+                      "gpt_t16k_selected_accum",
+                      "gpt_t16k_selected_block_q",
+                      "gpt_t16k_selected_block_k"))
+        check(same_sel and demo_base.get("gpt_t16k_selected_policy")
+              is not None,
+              f"tuner ordering preserved: fitted model selects the same "
+              f"known-good schedule "
+              f"({demo_fit.get('gpt_t16k_selected_policy')} accum="
+              f"{demo_fit.get('gpt_t16k_selected_accum')})")
+
+        # -- 4. cache robustness: corrupt/truncated/schema-mismatch ----
+        path = cm.costmodel_path()
+        with open(path, encoding="utf-8") as fh:
+            good = fh.read()
+        corruptions = [
+            ("garbage", "{not json"),
+            ("truncated", good[: len(good) // 2]),
+            ("schema-mismatch", json.dumps(
+                {"schema_version": 999, "platforms": {}})),
+        ]
+        for name, payload in corruptions:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(payload)
+            e0 = reg.value("tune.costmodel_errors")
+            cm.reset_model()
+            m = cm.get_model()
+            check(m.stale_reason is not None
+                  and cm.active_entry("cpu") is None
+                  and reg.value("tune.costmodel_errors") == e0 + 1,
+                  f"{name} model file degrades to analytic defaults "
+                  f"({m.stale_reason}; tune.costmodel_errors +1)")
+            att_c = attr.attribute_hlo(_TOY_HLO)
+            check(json.dumps(att_c, sort_keys=True)
+                  == json.dumps(att_base, sort_keys=True),
+                  f"{name}: attribution bit-exact to the no-model "
+                  f"baseline")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(good)
+        cm.reset_model()
+        check(cm.model_status().get("mode") == "fitted",
+              "restoring the good file restores the fit")
+
+        # -- 5. kill switch: bit-exact with a valid fitted file --------
+        os.environ["PADDLE_TPU_COSTMODEL"] = "0"
+        cm.reset_model()
+        att_off = attr.attribute_hlo(_TOY_HLO)
+        check(json.dumps(att_off, sort_keys=True)
+              == json.dumps(att_base, sort_keys=True),
+              "PADDLE_TPU_COSTMODEL=0 attribution BIT-EXACT vs the "
+              "no-model baseline (fitted file on disk)")
+        check(_hbm_points() == hbm_base,
+              "PADDLE_TPU_COSTMODEL=0 estimate_gpt_step_hbm ints "
+              "bit-exact vs the no-model baseline")
+        demo_off = tune.flagship_static_demo()
+        check(demo_off == demo_base,
+              "PADDLE_TPU_COSTMODEL=0 flagship static demo identical "
+              "to the no-model baseline")
+        check(cm.model_status() == {"mode": "analytic"},
+              "kill switch reports analytic status")
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        tune.reset_cache()
+        cm.reset_model()
+
+    print("costmodel selftest " + ("FAILED" if failures else "PASSED"))
+    return 1 if failures else 0

@@ -322,6 +322,12 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
               if k in win and win[k] is not None}
     meas = {k: win[k] for k in ("median_s", "tok_s", "flops",
                                 "bytes_accessed", "hbm_high_water_bytes",
+                                # the analytic HBM bound the candidate
+                                # was admitted under (prune_static):
+                                # paired with the compiled high water
+                                # above it is one hbm_scale calibration
+                                # point for the learned cost model
+                                "hbm_est_bytes",
                                 "temp_bytes") if win.get(k) is not None}
     meas["worst_median_s"] = max(m["median_s"] for m in timed)
     meas["measured_candidates"] = len(timed)

@@ -274,7 +274,21 @@ def estimate_gpt_step_hbm(n_layer, d_model, n_head, vocab, seq_len,
     # policy, one layer's full activations exist while it runs)
     live_layer = (_LIVE_LAYER_FLOATS_PER_TOKEN
                   * d_model * mb * seq_len * dtype_size)
-    return int(params + opt_state + grads + saved + live_layer)
+    est = int(params + opt_state + grads + saved + live_layer)
+    # calibrated HBM scale from the learned cost model (measured vs
+    # estimated high water over the corpus, tune/costmodel.py).  The
+    # scale is clamped >= 1.0 — the bound is a PRUNE, so calibration
+    # may only make it more conservative — and is exactly 1.0 when no
+    # fitted model is loadable or PADDLE_TPU_COSTMODEL=0 (bit-exact).
+    try:
+        from .costmodel import hbm_scale_for
+
+        scale = hbm_scale_for()
+    except Exception:  # noqa: BLE001 — mid-bootstrap partial import
+        scale = 1.0
+    if scale != 1.0:
+        est = int(est * scale)
+    return est
 
 
 def prune_static(seq_len, d_head, n_head, candidates, dtype_size=2,
@@ -328,11 +342,32 @@ def prune_static(seq_len, d_head, n_head, candidates, dtype_size=2,
     if not scored:
         return passthrough, pruned
     best = min(s for s, _ in scored)
+    # calibrated roofline: when a fitted cost model is loadable, the
+    # slack test compares FITTED schedule costs (ms) instead of raw
+    # scheduled flops — prediction is monotonic in flops so candidate
+    # ordering is unchanged (the --costmodel-selftest contract); only
+    # the ratio moves, because the fitted per-step overhead dilutes
+    # small flop deltas.  No model / kill switch -> the flop ratio,
+    # exactly as before.
+    cm_entry = None
+    try:
+        from . import costmodel as _cm
+
+        cm_entry = _cm.active_entry()
+    except Exception:  # noqa: BLE001 — mid-bootstrap partial import
+        cm_entry = None
+    if cm_entry is not None:
+        cost_of = lambda s: _cm.predict_sched_ms(cm_entry, s)  # noqa: E731
+    else:
+        cost_of = float
+    best_cost = cost_of(best)
     survivors = list(passthrough)
     for sched, c in scored:
-        if sched > best * roofline_slack:
+        if cost_of(sched) > best_cost * roofline_slack:
+            what = ("calibrated roofline" if cm_entry is not None
+                    else "roofline")
             pruned.append(
-                (c, f"roofline: schedules {sched / best:.2f}x the best "
+                (c, f"{what}: schedules {sched / best:.2f}x the best "
                     f"candidate's flops"))
             continue
         if hbm_budget and hbm_model is not None:

@@ -136,6 +136,22 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_kernels.log >&2
     exit 1
 fi
+# learned-cost-model smoke: the observability->tuning loop closed — two
+# real CPU-measured toy-GPT runs seed the measurement corpus through the
+# production MetricsReporter JSONL path, the fitted roofline's holdout
+# error strictly improves on the analytic model's recorded error, the
+# t=16k static prune under the fitted model still rejects the known-OOM
+# BENCH_r05 config and selects the same known-good schedule, corrupt/
+# truncated/schema-mismatched model files degrade to analytic defaults,
+# and PADDLE_TPU_COSTMODEL=0 is bit-exact vs the no-model baseline
+# (docs/observability.md "Cost model calibration")
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --costmodel-selftest \
+        > /tmp/_t1_costmodel.log 2>&1; then
+    echo "TIER1 REGRESSION: costmodel selftest failed" >&2
+    cat /tmp/_t1_costmodel.log >&2
+    exit 1
+fi
 # attribution smoke: the per-op performance attribution engine + crash
 # flight recorder — the compiled GPT flagship-family step's attribution
 # table covers >= 95% of cost-analysis flops with a tune-style workload
