@@ -1470,6 +1470,23 @@ def cmd_resilience_selftest(args=None):
     return run_selftest()
 
 
+def cmd_spec_selftest(args=None):
+    """``python -m paddle_tpu --spec-selftest``: speculative decoding's
+    CI gate, CPU-only — a depth-pruned draft engine emits TOKEN-EXACT
+    output vs single-stream greedy (f32 + bf16, prefix reuse on/off); a
+    self-draft run's acceptance rate near 1 proves the parallel verify
+    window bit-consistent with the sequential decode step; an
+    adversarial draft (different random init) still yields exact output
+    with >= 1 committed token per round; propose/rollback leaves
+    ``blocks_in_use`` at the plain engine's baseline (zero scratch
+    leak); and ``PADDLE_TPU_SPEC=0`` with a draft passed is bit-exact
+    with zero spec metrics (docs/serving.md "Speculative decoding").
+    Wired into tools/tier1.sh."""
+    from .serving.spec_selftest import run_selftest
+
+    return run_selftest()
+
+
 def main(argv=None):
     from .flags import init_flags
 
@@ -1497,6 +1514,8 @@ def main(argv=None):
         return cmd_costmodel_selftest()
     if "--attribution-selftest" in argv:
         return cmd_attribution_selftest()
+    if "--spec-selftest" in argv:
+        return cmd_spec_selftest()
     if "--bench-history" in argv:
         return cmd_bench_history(argv)
     if "--lint" in argv:

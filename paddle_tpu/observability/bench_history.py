@@ -88,7 +88,8 @@ _LOWER_IS_BETTER = {
 }
 _MULTICHIP_METRICS = ("scaling_efficiency", "param_bytes_per_device")
 _SERVING_METRICS = ("tok_s", "speedup", "goodput_under_slo",
-                    "prefix_hit_rate")
+                    "prefix_hit_rate", "spec_goodput_under_slo",
+                    "spec_accept_rate", "spec_speedup")
 
 # a per-class share has to move at least this much (absolute) before
 # the regression attribution names it — sub-2% wiggle is measurement

@@ -36,7 +36,11 @@ kind           point               effect at the n-th arrival
                                    engine kills one active request
                                    mid-decode — its slot AND its paged KV
                                    blocks must be reclaimed (no block
-                                   leak) and the driver must survive
+                                   leak) and the driver must survive.  In
+                                   speculative mode the decode point sits
+                                   MID-VERIFY, so the victim also holds a
+                                   draft scratch chain: both chains must
+                                   come back (tests/test_speculative.py)
 =============  ==================  =======================================
 
 Arrival counters are per-process module state; ``reset()`` exists for

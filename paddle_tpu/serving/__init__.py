@@ -14,14 +14,16 @@ by the bucket set, and full ``serving.*`` telemetry through the
 observability registry.
 """
 
-from . import batched_decode, kvcache, scheduler
+from . import batched_decode, kvcache, scheduler, speculative
 from .engine import Request, ServingEngine
 from .kvcache import BlockPool, PoolExhausted, PrefixTrie
 from .scheduler import (FifoScheduler, SheddedRequest, SloScheduler,
                         TtftPredictor)
+from .speculative import depth_draft, spec_enabled
 
 __all__ = [
     "Request", "ServingEngine", "batched_decode", "kvcache", "scheduler",
+    "speculative", "depth_draft", "spec_enabled",
     "BlockPool", "PoolExhausted", "PrefixTrie",
     "FifoScheduler", "SheddedRequest", "SloScheduler", "TtftPredictor",
 ]

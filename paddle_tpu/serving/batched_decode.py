@@ -18,7 +18,7 @@ gathers THROUGH the table, so:
   overrun steps (a finished slot riding out the chunk, prefill bucket
   padding) write garbage there and nowhere else.
 
-Three compiled entry points, built once per engine:
+Four compiled entry points, built once per engine:
 
 * ``make_decode_chunk`` — a ``lax.scan`` of ``chunk`` batched steps
   between host syncs; K/V for attention is gathered ``pool[table]`` per
@@ -34,6 +34,13 @@ Three compiled entry points, built once per engine:
   into the SAME executable as a leading whole-block copy, so CoW adds
   no executable (``cow_src == cow_dst == 0`` is the no-op spelling —
   trash copied onto trash).
+* ``make_verify_window`` — the speculative-decoding verify step
+  (``serving.speculative``): ONE teacher-forced forward over a
+  ``k + 1``-token window per slot (the slot's committed last token
+  followed by its k draft proposals), scoring every window position in
+  parallel through the same block-table gather.  The window rides the
+  decode executable shape — the table is data — so speculative decode
+  adds exactly one executable per engine, never one per ``k``.
 
 Correctness discipline (unchanged from the contiguous engine): every op
 is row-wise per slot, each step writes position ``t`` BEFORE attending
@@ -48,7 +55,8 @@ acceptance bar, ``tests/test_serving.py`` / ``tests/test_kvcache.py``).
 import jax
 import jax.numpy as jnp
 
-__all__ = ["paged_step_logits", "make_decode_chunk", "make_prefill"]
+__all__ = ["paged_step_logits", "make_decode_chunk", "make_prefill",
+           "make_verify_window"]
 
 
 def _gather_kv(pool, table):
@@ -161,6 +169,93 @@ def make_decode_chunk(n_layer, n_head, d_model, chunk, eps=1e-5,
 
     return jax.jit(decode_chunk,
                    donate_argnums=(1, 2, 3, 4) if donate else ())
+
+
+def make_verify_window(n_layer, n_head, d_model, k, eps=1e-5,
+                       donate=True):
+    """Build the speculative VERIFY executable: one teacher-forced
+    target forward over a ``W = k + 1``-token window for every slot.
+
+    ``fn(params, pool_k, pool_v, toks [S, W], pos [S], limit [S],
+    table [S, NB]) -> (pool_k', pool_v', greedy [S, W] int32)`` —
+    ``toks[s] = [last_s, d_1 .. d_k]`` (the committed last token
+    followed by the slot's draft proposals), window position ``j``
+    lives at logical position ``pos_s + j``, and ``greedy[s, j]`` is
+    the target's argmax after consuming ``toks[s, j]`` there — exactly
+    the token sequential greedy decode would emit after the prefix
+    extended by ``toks[s, :j]``.  Scoring all W positions in ONE
+    forward (each attends the cached chain plus the in-window
+    positions ``<= pos_s + j``, all written before any gather) is the
+    speculative win: the weights are read once for W tokens instead of
+    W times.
+
+    ``limit[s]`` is the last logical position slot ``s`` may ever
+    legitimately write (``p_len + max_new - 1``; ``-1`` for a dead
+    slot): window positions beyond it route their K/V writes to the
+    trash block, so a window overhanging the end of a request — or a
+    slot killed mid-round — can never scatter into a live block.
+    Without this, two window positions clamped to the same table entry
+    would race their ``.at[].set`` writes.  Greedy outputs at
+    positions past ``limit`` are garbage; the host-side acceptance
+    walk never commits them.
+    """
+    W = k + 1
+
+    def verify(p, pool_k, pool_v, toks, pos, limit, table):
+        S = toks.shape[0]
+        NB = table.shape[1]
+        B = pool_k[0].shape[1]
+        T = NB * B
+        dh = d_model // n_head
+        rows = jnp.arange(S)
+        P = pos[:, None] + jnp.arange(W)[None, :]            # [S, W]
+        Pw = jnp.clip(P, 0, T - 1)
+        writable = P <= limit[:, None]
+        blk = jnp.where(writable, table[rows[:, None], Pw // B], 0)
+        off = Pw % B
+        x = p["tok_emb.w"][toks] + p["pos_emb.w.w"][Pw]      # [S, W, d]
+        for i in range(n_layer):
+            w = lambda nm: p[f"block{i}_{nm}"]
+            h = _ln(x, w("ln1.scale"), w("ln1.bias"), eps)
+            q = h @ w("att_q.w") + w("att_q.b")
+            kk = h @ w("att_k.w") + w("att_k.b")
+            v = h @ w("att_v.w") + w("att_v.b")
+            qh = q.reshape(S, W, n_head, dh)
+            kh = kk.reshape(S, W, n_head, dh)
+            vh = v.reshape(S, W, n_head, dh)
+            # all W writes land before the gather below — the same
+            # write-before-attend discipline as the sequential step,
+            # collapsed into one scatter (distinct live positions,
+            # disjoint per-slot blocks, overruns trashed via `limit`)
+            pk = pool_k[i].at[blk, off].set(kh)
+            pv = pool_v[i].at[blk, off].set(vh)
+            pool_k = pool_k[:i] + (pk,) + pool_k[i + 1:]
+            pool_v = pool_v[:i] + (pv,) + pool_v[i + 1:]
+            ck = _gather_kv(pk, table)                       # [S, T, h, dh]
+            cv = _gather_kv(pv, table)
+            s = jnp.einsum("swhd,sThd->swhT", qh, ck,
+                           preferred_element_type=jnp.float32)
+            s = s / jnp.sqrt(float(dh))
+            # one causal mask covers the cached chain AND the
+            # in-window positions: window slot j attends <= pos + j
+            mask = (jnp.arange(T)[None, None, None, :]
+                    <= P[:, :, None, None])
+            s = jnp.where(mask, s, -1e30)
+            a = jax.nn.softmax(s, axis=-1).astype(ck.dtype)
+            ctx = jnp.einsum("swhT,sThd->swhd", a, cv).reshape(
+                S, W, d_model)
+            x = x + ctx @ w("att_out.w") + w("att_out.b")
+            h2 = _ln(x, w("ln2.scale"), w("ln2.bias"), eps)
+            ff = jax.nn.gelu(h2 @ w("ffn1.w") + w("ffn1.b"),
+                             approximate=False)
+            x = x + ff @ w("ffn2.w") + w("ffn2.b")
+        x = _ln(x, p["ln_f.scale"], p["ln_f.bias"], eps)
+        logits = jnp.matmul(x, p["lm_head.w"],
+                            preferred_element_type=jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return pool_k, pool_v, greedy
+
+    return jax.jit(verify, donate_argnums=(1, 2) if donate else ())
 
 
 def make_prefill(n_layer, n_head, d_model, bucket, eps=1e-5,

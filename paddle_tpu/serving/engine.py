@@ -64,6 +64,7 @@ from ..resilience import faults as _faults
 from . import batched_decode as _bd
 from . import kvcache as _kv
 from . import scheduler as _sched
+from . import speculative as _spec
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -85,7 +86,8 @@ class Request:
                  "submit_t", "first_token_t", "finish_t", "error",
                  "admit_t", "prefill_t0", "prefill_t1", "bucket",
                  "chunks", "slo_ok", "ttft_slo_s", "e2e_slo_s",
-                 "shed", "sheddable", "prefix_hit", "_done")
+                 "shed", "sheddable", "prefix_hit",
+                 "spec_proposed", "spec_accepted", "_done")
 
     def __init__(self, rid, prompt, max_new, eos_id,
                  ttft_slo_s=None, e2e_slo_s=None, sheddable=True):
@@ -121,6 +123,10 @@ class Request:
         self.sheddable = sheddable
         # prompt tokens whose prefill was skipped via the prefix trie
         self.prefix_hit = 0
+        # speculative accounting (0 when the engine has no draft):
+        # draft tokens proposed for / accepted by this request
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self._done = threading.Event()
 
     @property
@@ -193,6 +199,24 @@ class ServingEngine:
              shedding; with no budgets configured it degrades to FIFO
              order) or "fifo" (the PR-2 baseline policy).
     eos_id   default EOS token id (per-request override in ``submit``).
+    draft_params  parameter dict of a small DRAFT model (same
+             ``transformer.build`` family: identical vocab / d_model /
+             head geometry, fewer layers — e.g.
+             ``speculative.depth_draft``).  When given (and
+             ``PADDLE_TPU_SPEC`` is not off), decode runs SPECULATIVE
+             rounds: the draft proposes ``spec_k`` tokens per slot into
+             scratch block chains, one target verify forward scores the
+             whole window, greedy acceptance commits the agreeing
+             prefix + bonus token — TOKEN-EXACT vs plain greedy decode
+             (docs/serving.md "Speculative decoding").  Geometry
+             mismatches raise at construction.
+    draft_n_layer / draft_n_head  the draft's depth / head count
+             (default: inferred depth / the target's ``n_head``; a
+             differing head count is rejected — the draft shares the
+             target's paged pool arrays).
+    spec_k   draft tokens proposed per round.  ``None`` consults the
+             tuned ``op=spec_decode`` entry (docs/autotune.md) and
+             falls back to 4; an explicit value always wins.
     ttft_slo_s / e2e_slo_s   per-request latency budgets (seconds),
              overridable per request in ``submit``.  When set, every
              finished request is judged at finish time
@@ -212,7 +236,8 @@ class ServingEngine:
                  eos_id=None, compute_dtype=None, eps=1e-5, donate=True,
                  registry=None, ttft_slo_s=None, e2e_slo_s=None,
                  block_tokens=16, cache_blocks=None, prefix_reuse=True,
-                 scheduler="slo"):
+                 scheduler="slo", draft_params=None, draft_n_layer=None,
+                 draft_n_head=None, spec_k=None):
         import jax
         import jax.numpy as jnp
 
@@ -261,6 +286,22 @@ class ServingEngine:
             {k: jnp.asarray(v, self.compute_dtype)
              for k, v in params.items()})
 
+        # -- speculative decoding (serving.speculative): with a draft
+        # model and the PADDLE_TPU_SPEC switch on, decode runs
+        # propose/verify/commit rounds.  Off (or no draft): none of
+        # this exists — no validation, no extra pool blocks, no draft
+        # executables — bit-identical to the plain engine.
+        spec_on = draft_params is not None and _spec.spec_enabled()
+        if spec_on:
+            draft_n_layer = _spec.validate_draft(
+                params, draft_params, n_layer, n_head, d_model,
+                self.max_len, draft_n_layer=draft_n_layer,
+                draft_n_head=draft_n_head)
+            if spec_k is None:
+                spec_k = int(self._tuned_spec().get(
+                    "k", _spec.DEFAULT_SPEC_K))
+        self.spec_k = int(spec_k) if spec_on else None
+
         # -- paged KV state (kvcache.py): pool arrays + host accounting
         self.block_tokens = int(block_tokens)
         self.blocks_per_slot = -(-self.max_len // self.block_tokens)
@@ -271,9 +312,14 @@ class ServingEngine:
         self.cache_blocks = int(cache_blocks)
         # trash block + every slot's worst-case chain + the cache
         # budget: admission can ALWAYS allocate a full chain once the
-        # trie evicts its unreferenced tail (kvcache.py invariants)
+        # trie evicts its unreferenced tail (kvcache.py invariants).
+        # Speculative mode reserves a second worst-case chain per slot
+        # for the draft's scratch blocks, so a propose round can never
+        # starve admission.
         num_blocks = (1 + self.max_slots * self.blocks_per_slot
                       + self.cache_blocks)
+        if spec_on:
+            num_blocks += self.max_slots * self.blocks_per_slot
         self.kv_pool = _kv.BlockPool(num_blocks, self.block_tokens)
         self.prefix_trie = (_kv.PrefixTrie(self.kv_pool, self.cache_blocks)
                             if prefix_reuse else None)
@@ -291,6 +337,8 @@ class ServingEngine:
         self._table = np.zeros((self.max_slots, self.blocks_per_slot),
                                np.int32)
         self._slot_blocks = [None] * self.max_slots  # bids a slot holds
+        self._spec = (_spec.SpecState(self, draft_params, draft_n_layer,
+                                      spec_k) if spec_on else None)
 
         self._slots = [None] * self.max_slots     # Request or None
         self._free = list(range(self.max_slots))  # LIFO free list
@@ -333,6 +381,19 @@ class ServingEngine:
             from .. import tune
 
             return tune.serving_decode_config(
+                self.max_len, self.d_model // self.n_head, self.n_head,
+                self.compute_dtype) or {}
+        except Exception:  # noqa: BLE001 — lookup is best-effort
+            return {}
+
+    def _tuned_spec(self):
+        """The tuned ``op=spec_decode`` config (the draft window ``k``)
+        for this engine's shape, or {} — same never-raises contract as
+        :meth:`_tuned_geometry`."""
+        try:
+            from .. import tune
+
+            return tune.spec_decode_config(
                 self.max_len, self.d_model // self.n_head, self.n_head,
                 self.compute_dtype) or {}
         except Exception:  # noqa: BLE001 — lookup is best-effort
@@ -457,6 +518,8 @@ class ServingEngine:
                 for b in self._slot_blocks[s] or ():
                     self.kv_pool.deref(b)
                 self._slot_blocks[s] = None
+                if self._spec is not None:
+                    self._spec.release(self, s)
             self._table[:] = 0
             self._free = list(range(self.max_slots))
             for req in pending:
@@ -579,27 +642,35 @@ class ServingEngine:
 
         box = {}
 
+        def prepare(*args):
+            # compile (once) SEPARABLY from execution: call sites that
+            # time their call and feed the wall into the scheduler's
+            # latency predictor invoke this first, outside the timed
+            # window — an EMA seeded with a one-time compile wall would
+            # shed every arrival against a regime that no longer exists
+            if box.get("c") is not None:
+                return
+            try:
+                c = fn.lower(*args).compile()
+            except Exception:
+                box["c"] = fn  # no AOT on this backend: plain jit
+                return
+            box["c"] = c
+            stats = compiled_memory_stats(c)
+            if stats:
+                self._reg.gauge(
+                    "serving.hbm_high_water_bytes", label=label,
+                    help="compiled-executable HBM high-water "
+                         "(memory_analysis)",
+                ).set_max(stats["hbm_high_water_bytes"])
+                self._reg.gauge(
+                    "serving.temp_bytes", label=label,
+                    help="compiled-executable HLO temp bytes",
+                ).set_max(stats["temp_bytes"])
+
         def call(*args):
-            c = box.get("c")
-            if c is None:
-                try:
-                    c = fn.lower(*args).compile()
-                except Exception:
-                    box["c"] = fn  # no AOT on this backend: plain jit
-                    return fn(*args)
-                box["c"] = c
-                stats = compiled_memory_stats(c)
-                if stats:
-                    self._reg.gauge(
-                        "serving.hbm_high_water_bytes", label=label,
-                        help="compiled-executable HBM high-water "
-                             "(memory_analysis)",
-                    ).set_max(stats["hbm_high_water_bytes"])
-                    self._reg.gauge(
-                        "serving.temp_bytes", label=label,
-                        help="compiled-executable HLO temp bytes",
-                    ).set_max(stats["temp_bytes"])
-            return c(*args)
+            prepare(*args)
+            return box["c"](*args)
 
         def cache_size():
             # executable count, same contract as jit's _cache_size():
@@ -612,6 +683,7 @@ class ServingEngine:
             return 1
 
         call._cache_size = cache_size
+        call.prepare = prepare
         return call
 
     def bucket_for(self, p_len):
@@ -648,6 +720,9 @@ class ServingEngine:
             self.kv_pool.deref(b)
         self._slot_blocks[slot] = None
         self._table[slot] = 0
+        if self._spec is not None:
+            # the slot's draft scratch chain obeys the same discipline
+            self._spec.release(self, slot)
         self._slots[slot] = None
         self._free.append(slot)
         if self.prefix_trie is not None:
@@ -658,6 +733,8 @@ class ServingEngine:
             self.kv_pool.blocks_in_use)
 
     def _decode(self):
+        if self._spec is not None:
+            return self._spec_decode()
         if self._decode_fn is None:
             self._decode_fn = self._aot_with_mem_telemetry(
                 _bd.make_decode_chunk(
@@ -677,10 +754,15 @@ class ServingEngine:
             self._kill_one_slot()
             if not self.active_slots:
                 return 0
+        # one-time AOT compile lands here, outside the timed window the
+        # predictor consumes
+        tbl = jnp.asarray(self._table)
+        self._decode_fn.prepare(self._p, self._pk, self._pv, self._last,
+                                self._pos, tbl)
         t0 = time.perf_counter()
         (self._pk, self._pv, self._last, self._pos,
          toks) = self._decode_fn(self._p, self._pk, self._pv, self._last,
-                                 self._pos, jnp.asarray(self._table))
+                                 self._pos, tbl)
         toks = np.asarray(toks)  # host sync: [chunk, S]
         t1 = time.perf_counter()
         wall = t1 - t0
@@ -720,6 +802,159 @@ class ServingEngine:
         self._reg.counter("serving.tokens").inc(emitted)
         if wall > 0:
             self._reg.gauge("serving.tok_s").set(emitted / wall)
+        self._reg.gauge("serving.slots_active").set(self.active_slots)
+        return finished
+
+    def _spec_decode(self):
+        """One speculative round (serving.speculative): the draft
+        proposes ``spec_k`` tokens per slot into scratch chains, ONE
+        target verify forward scores every slot's ``k + 1``-token
+        window, greedy acceptance commits the agreeing prefix plus the
+        bonus token (token-exact vs plain greedy by induction), and
+        scratch blocks past the committed frontier roll back to the
+        pool.  At least one token commits per live slot per round, so
+        progress is guaranteed even under a hostile draft."""
+        import jax.numpy as jnp
+
+        sp = self._spec
+        k = sp.k
+        B = self.block_tokens
+        S = self.max_slots
+        # per-slot committed frontier, rebuilt from host truth each
+        # round: last committed token + its position + the last
+        # position the slot may ever write (the verify write limit)
+        last_h = np.zeros(S, np.int32)
+        pos_h = np.zeros(S, np.int32)
+        limit_h = np.full(S, -1, np.int32)
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            p_len = req.prompt.shape[0]
+            last_h[s] = req.tokens[-1]
+            pos_h[s] = p_len + len(req.tokens) - 1
+            limit_h[s] = p_len + req.max_new - 1
+            hi = min(pos_h[s] + k, limit_h[s])
+            sp.ensure_chain(self, s, int(hi) // B + 1)
+        # the draft-chunk / verify-window one-time AOT compiles land
+        # here, outside the timed window the predictor consumes (same
+        # contract as the plain decode chunk); lowering needs only
+        # shapes, so the verify prepares against a placeholder window
+        nl = sp.n_layer
+        sp.chunk_fn(self).prepare(
+            sp.p, self._pk[:nl], self._pv[:nl], jnp.asarray(last_h),
+            jnp.asarray(pos_h), jnp.asarray(sp.table))
+        sp.verify_fn(self).prepare(
+            self._p, self._pk, self._pv,
+            jnp.asarray(np.zeros((S, k + 1), np.int32)),
+            jnp.asarray(pos_h), jnp.asarray(limit_h),
+            jnp.asarray(self._table))
+        t0 = time.perf_counter()
+        drafts = sp.propose(self, last_h, pos_h)       # [k, S] host
+        t_d = time.perf_counter()
+        self._reg.gauge(
+            "serving.spec_draft_ms",
+            help="draft propose wall time per speculative round (ms)",
+        ).set((t_d - t0) * 1000.0)
+        # fault injection point (PADDLE_TPU_FAULT=slot_death:n): in
+        # speculative mode the decode-point death fires MID-VERIFY —
+        # between propose and commit, the widest window of in-flight
+        # scratch state.  The killed slot's real AND draft chains are
+        # reclaimed (_release_slot), its table rows zero, and the
+        # verify below runs with its write limit dropped to -1, so the
+        # dead slot scatters only into the trash block.
+        if _faults.maybe_fault("serving.decode") == "slot_death":
+            self._kill_one_slot()
+            if not self.active_slots:
+                return 0
+        for s in range(S):
+            if self._slots[s] is None:
+                limit_h[s] = -1
+        U = np.zeros((S, k + 1), np.int32)
+        U[:, 0] = last_h
+        U[:, 1:] = drafts.T
+        (self._pk, self._pv, greedy) = sp.verify_fn(self)(
+            self._p, self._pk, self._pv, jnp.asarray(U),
+            jnp.asarray(pos_h), jnp.asarray(limit_h),
+            jnp.asarray(self._table))
+        greedy = np.asarray(greedy)                    # host sync [S, k+1]
+        t1 = time.perf_counter()
+        wall = t1 - t0
+        active = self.active_slots
+        tracer = self._tracer
+        if tracer.enabled:
+            for req in self._slots:
+                if req is not None:
+                    req.chunks.append((t0, t1))
+        emitted = 0
+        finished = 0
+        round_acc = 0
+        now = time.perf_counter()
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            remaining = req.max_new - len(req.tokens)
+            commit, n_matched = _spec.accept_greedy(
+                drafts[:, s], greedy[s], remaining)
+            done = False
+            appended = 0
+            for tok in commit:
+                req.tokens.append(tok)
+                emitted += 1
+                appended += 1
+                if ((req.eos_id is not None and tok == req.eos_id)
+                        or len(req.tokens) >= req.max_new):
+                    done = True
+                    break
+            acc = min(n_matched, appended)
+            # acceptance is judged over the draft tokens that COULD
+            # have committed (the request's remaining window), not the
+            # full k — end-of-request rounds would otherwise dilute the
+            # rate and make the predictor's steps-per-round estimate,
+            # and the reported draft quality, look worse than they are
+            eff = min(k, max(0, remaining - 1))
+            sp.proposed += eff
+            sp.accepted += acc
+            round_acc += acc
+            req.spec_proposed += eff
+            req.spec_accepted += acc
+            if done:
+                self._release_slot(s)
+                self._finish(req, now)
+                finished += 1
+            else:
+                # the draft KV is valid through the new frontier - 1;
+                # scratch blocks past it held rejected-token state
+                pos2 = req.prompt.shape[0] + len(req.tokens) - 1
+                sp.rollback(self, s, (int(pos2) - 1) // B + 1)
+        self._reg.counter("serving.tokens").inc(emitted)
+        if wall > 0:
+            self._reg.gauge("serving.tok_s").set(emitted / wall)
+        if sp.proposed:
+            self._reg.gauge(
+                "serving.spec_accept_rate",
+                help="draft tokens accepted / proposed since the last "
+                     "accounting reset",
+            ).set(sp.accepted / sp.proposed)
+        self._reg.histogram("serving.decode_chunk").observe(wall)
+        self._reg.histogram("serving.step_seconds").observe(
+            wall / (k + 1))
+        if active:
+            # steps-per-round for the predictor: the steady-state
+            # expectation 1 + accept_rate * k, not this round's
+            # emitted/active — single rounds are noisy (slots finishing
+            # mid-window report 1-2 tokens) and the predictor keeps
+            # only the LAST steps value, so a noisy round would swing
+            # predicted decode time by k x and mis-shed arrivals
+            if sp.proposed:
+                steps = 1 + k * (sp.accepted / sp.proposed)
+            else:
+                steps = emitted / active
+            self.predictor.observe_chunk(wall, max(1, int(round(steps))))
+        tracer.add_span("serving.spec_round", t0, t1, cat="serving",
+                        k=k, active=active, emitted=emitted,
+                        accepted=round_acc)
+        self._reg.gauge("serving.blocks_in_use").set(
+            self.kv_pool.blocks_in_use)
         self._reg.gauge("serving.slots_active").set(self.active_slots)
         return finished
 
@@ -877,6 +1112,12 @@ class ServingEngine:
         fn = self._prefill_fn(bucket)
         padded = np.zeros(bucket, np.int32)
         padded[:suffix] = req.prompt[start:]
+        # this bucket's one-time AOT compile lands here, outside the
+        # timed window the predictor consumes
+        fn.prepare(self._p, self._pk, self._pv, self._last, self._pos,
+                   np.int32(slot), jnp.asarray(row), jnp.asarray(padded),
+                   np.int32(start), np.int32(suffix), np.int32(cow_src),
+                   np.int32(cow_dst))
         t_p0 = time.perf_counter()
         (self._pk, self._pv, self._last, self._pos,
          first) = fn(self._p, self._pk, self._pv, self._last, self._pos,
@@ -891,6 +1132,25 @@ class ServingEngine:
             pool.deref(cow[0])
         self._table[slot] = row
         self._slot_blocks[slot] = list(shared) + list(priv)
+        if self._spec is not None:
+            # draft prefill: scan the FULL prompt through the draft
+            # into the slot's scratch chain so the first propose round
+            # has a complete draft KV.  Runs before any request-state
+            # mutation below so an (unlikely — the pool reserves a
+            # draft chain per slot) PoolExhausted re-queues cleanly.
+            try:
+                self._spec.prefill(self, slot, req)
+            except _kv.PoolExhausted:
+                for b in self._slot_blocks[slot] or ():
+                    pool.deref(b)
+                self._slot_blocks[slot] = None
+                self._table[slot] = 0
+                self._spec.release(self, slot)
+                if trie is not None:
+                    trie.enforce_budget()
+                self._reg.gauge("serving.blocks_in_use").set(
+                    pool.blocks_in_use)
+                raise
         if trie is not None:
             # register the prompt's FULL blocks (shared ones are
             # already cached and skipped; our private full blocks
@@ -983,10 +1243,15 @@ class ServingEngine:
             self._first_submit_t = None
             self._hit_tokens = 0
             self._prompt_tokens = 0
+            if self._spec is not None:
+                self._spec.proposed = 0
+                self._spec.accepted = 0
         for nm in ("serving.slo_violations", "serving.goodput_tok_s",
                    "serving.shed_total", "serving.prefix_hit_rate",
                    "serving.prefix_hit_tokens", "serving.prefill_tokens",
-                   "serving.cow_copies"):
+                   "serving.cow_copies", "serving.spec_accept_rate",
+                   "serving.spec_draft_ms",
+                   "serving.spec_rollback_blocks"):
             m = self._reg.get(nm)
             if m is not None:
                 m.reset()
@@ -1049,11 +1314,14 @@ class ServingEngine:
         if not tr.enabled or req.error is not None or req.admit_t is None:
             return
         lane = f"serving req {self._req_lane(req)}"
+        spec_attrs = ({"spec_proposed": req.spec_proposed,
+                       "spec_accepted": req.spec_accepted}
+                      if req.spec_proposed else {})
         tr.add_span("serving.request", req.submit_t, req.finish_t,
                     cat="serving", lane=lane, timer=False, rid=req.rid,
                     prompt_len=int(req.prompt.shape[0]),
                     tokens=len(req.tokens),
-                    prefix_hit=req.prefix_hit)
+                    prefix_hit=req.prefix_hit, **spec_attrs)
         tr.add_span("serving.req.queue", req.submit_t, req.admit_t,
                     cat="serving", lane=lane, timer=False, rid=req.rid)
         if req.prefill_t0 is not None:

@@ -1,0 +1,200 @@
+"""``python -m paddle_tpu --spec-selftest`` — speculative decoding's
+CI gate, CPU-only (wired into tools/tier1.sh; docs/serving.md
+"Speculative decoding").
+
+The acceptance bar is the house bit-exactness contract applied to the
+propose/verify/commit loop:
+
+1. PARITY: a speculative engine (depth-pruned draft) emits TOKEN-EXACT
+   output vs single-stream ``transformer.generate`` greedy — f32 and
+   bf16, prefix reuse on and off, mixed prompt lengths.
+2. SELF-DRAFT: with the draft = the target itself, the acceptance rate
+   must be near 1 — an empirical probe that the parallel verify window
+   is bit-consistent with the sequential step (any numeric drift
+   between the two shows up as spurious rejections here).
+3. ADVERSARIAL: a draft from a DIFFERENT random init (near-zero
+   agreement) still yields exact output — acceptance only gates which
+   target tokens commit per round, never what they are — and at least
+   one token commits per round (progress under a hostile draft).
+4. ZERO LEAK: after serving, ``blocks_in_use`` equals the plain
+   engine's after the same workload — propose/rollback retains no
+   scratch blocks.
+5. KILL SWITCH: ``PADDLE_TPU_SPEC=0`` with a draft passed builds a
+   bit-identical plain engine — same tokens, no draft executables, no
+   spec metrics.
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["run_selftest"]
+
+_TOY = dict(vocab=50, n_layer=2, n_head=2, d_model=32, max_len=64)
+
+
+def _make_params(seed=7):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    pt.core.unique_name.reset()
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = seed
+    with pt.program_guard(main, startup):
+        transformer.build(
+            vocab_size=_TOY["vocab"], n_layer=_TOY["n_layer"],
+            n_head=_TOY["n_head"], d_model=_TOY["d_model"],
+            max_len=_TOY["max_len"], dropout_rate=0.0, dtype="float32")
+    pt.Executor().run(startup)
+    return transformer.extract_params(program=main)
+
+
+def _bf16(params):
+    import jax.numpy as jnp
+
+    return {k: (jnp.asarray(v, jnp.bfloat16)
+                if (k.startswith("block") or k.startswith("lm_head"))
+                and k.endswith(".w") else v)
+            for k, v in params.items()}
+
+
+def _engine(params, **kw):
+    from .engine import ServingEngine
+
+    kw.setdefault("max_len", _TOY["max_len"])
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("min_bucket", 4)
+    return ServingEngine(params, _TOY["n_layer"], _TOY["n_head"],
+                         _TOY["d_model"], **kw)
+
+
+def _ref_outputs(params, prompts, max_new):
+    from paddle_tpu.models import transformer
+
+    outs = []
+    for p in prompts:
+        toks, _ = transformer.generate(
+            params, p[None], max_len=_TOY["max_len"],
+            n_layer=_TOY["n_layer"], n_head=_TOY["n_head"],
+            d_model=_TOY["d_model"], return_logits=False)
+        outs.append(np.asarray(toks)[0][: len(p) + max_new])
+    return outs
+
+
+def run_selftest():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PADDLE_TPU_SPEC", None)
+    from paddle_tpu.observability import metrics as obs
+    from .speculative import depth_draft
+
+    failures = []
+
+    def check(cond, what):
+        (failures.append(what) if not cond else None)
+        print(("  ok  " if cond else "  FAIL") + " " + what)
+
+    params = _make_params(seed=7)
+    draft = depth_draft(params, 1)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, _TOY["vocab"], (n,)).astype(np.int32)
+               for n in (3, 5, 7, 4, 6, 2)]
+    max_new = 14
+    ref = _ref_outputs(params, prompts, max_new)
+
+    # 1. parity: depth-pruned draft, f32, reuse on and off
+    for reuse in (True, False):
+        eng = _engine(params, prefix_reuse=reuse, draft_params=draft,
+                      spec_k=3)
+        outs = eng.generate_many(prompts, max_new)
+        exact = all(np.array_equal(np.asarray(o), r)
+                    for o, r in zip(outs, ref))
+        check(exact, f"f32 parity vs transformer.generate "
+                     f"(reuse={reuse})")
+        check(eng._spec.proposed > 0,
+              f"speculative rounds actually ran (reuse={reuse})")
+
+    # bf16: spec engine vs plain engine, same cast weights (the plain
+    # engine's own bf16 parity vs generate is pinned in test_serving)
+    p16 = _bf16(params)
+    eng16 = _engine(p16, prefix_reuse=True,
+                    draft_params=depth_draft(p16, 1), spec_k=3)
+    plain16 = _engine(p16, prefix_reuse=True)
+    o16 = eng16.generate_many(prompts, max_new)
+    q16 = plain16.generate_many(prompts, max_new)
+    check(all(np.array_equal(np.asarray(a), np.asarray(b))
+              for a, b in zip(o16, q16)),
+          "bf16 parity: speculative == plain engine, bit-exact")
+
+    # 2. self-draft: draft == target must accept nearly everything —
+    # the empirical bit-consistency probe of the parallel verify window
+    eng_self = _engine(params, prefix_reuse=False, draft_params=params,
+                       spec_k=4)
+    outs = eng_self.generate_many(prompts, max_new)
+    check(all(np.array_equal(np.asarray(o), r)
+              for o, r in zip(outs, ref)), "self-draft parity")
+    sp = eng_self._spec
+    rate = sp.accepted / sp.proposed if sp.proposed else 0.0
+    check(rate >= 0.8,
+          f"self-draft acceptance ~1 (verify window bit-consistent "
+          f"with the sequential step): {rate:.3f}")
+
+    # 3. adversarial draft: a different random init — near-zero
+    # agreement, still token-exact, still >= 1 token per round
+    adv = depth_draft(_make_params(seed=1234), 1)
+    # small blocks so the frontier crosses block boundaries often —
+    # the rollback path (scratch blocks returned after rejection) runs
+    eng_adv = _engine(params, prefix_reuse=True, draft_params=adv,
+                      spec_k=4, block_tokens=4)
+    outs = eng_adv.generate_many(prompts, max_new)
+    check(all(np.array_equal(np.asarray(o), r)
+              for o, r in zip(outs, ref)),
+          "adversarial-draft parity (low acceptance, exact output)")
+    sp = eng_adv._spec
+    adv_rate = sp.accepted / sp.proposed if sp.proposed else 1.0
+    check(adv_rate < 0.5,
+          f"adversarial draft really is adversarial: {adv_rate:.3f}")
+
+    # 4. zero leak: spec engine retains exactly what the plain engine
+    # retains after the same workload (reuse on: the trie's cached
+    # chains; reuse off: nothing)
+    plain = _engine(params, prefix_reuse=True, block_tokens=4)
+    plain.generate_many(prompts, max_new)
+    check(eng_adv.kv_pool.blocks_in_use == plain.kv_pool.blocks_in_use,
+          f"zero scratch-block leak: spec in_use "
+          f"{eng_adv.kv_pool.blocks_in_use} == plain "
+          f"{plain.kv_pool.blocks_in_use}")
+    eng_off = _engine(params, prefix_reuse=False, draft_params=draft,
+                      spec_k=3)
+    eng_off.generate_many(prompts, max_new)
+    check(eng_off.kv_pool.blocks_in_use == 0,
+          "zero blocks in use after serving (reuse off)")
+
+    # spec metrics flow: executables counted before the registry is
+    # cleared for the kill-switch probe below
+    reg = obs.get_registry()
+    check(reg.value("serving.spec_compiles") > 0,
+          "serving.spec_compiles counted draft/verify executables")
+    check(reg.value("serving.spec_rollback_blocks") > 0,
+          "adversarial rejections rolled scratch blocks back")
+
+    # 5. kill switch: PADDLE_TPU_SPEC=0 ignores the draft wholesale
+    os.environ["PADDLE_TPU_SPEC"] = "0"
+    try:
+        obs.get_registry().clear(prefix="serving.")
+        eng_k = _engine(params, prefix_reuse=True, draft_params=draft,
+                        spec_k=3)
+        outs_k = eng_k.generate_many(prompts, max_new)
+        check(eng_k._spec is None,
+              "kill switch: no speculative state constructed")
+        check(all(np.array_equal(np.asarray(o), r)
+                  for o, r in zip(outs_k, ref)),
+              "kill switch: output bit-exact vs plain greedy")
+        snap = eng_k.stats()
+        check(not any(k.startswith("serving.spec_") for k in snap),
+              "kill switch: no serving.spec_* metrics emitted")
+    finally:
+        os.environ.pop("PADDLE_TPU_SPEC", None)
+
+    print("spec selftest " + ("FAILED" if failures else "PASSED"))
+    return 1 if failures else 0

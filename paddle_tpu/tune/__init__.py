@@ -46,20 +46,22 @@ from .costmodel import (
 from .space import (
     POLICY_ORDER, WorkloadKey, attention_candidates,
     estimate_gpt_step_hbm, prune_static, schedule_candidates,
-    serving_candidates)
+    serving_candidates, spec_candidates)
 from .search import (
     PreflightRejected, flagship_dims, flagship_static_demo,
-    tune_gpt_step, tune_serving_decode)
+    tune_gpt_step, tune_serving_decode, tune_spec_decode)
 
 __all__ = [
     "CACHE_SCHEMA_VERSION", "TuneCache", "cache_path",
     "geometry_fingerprint", "get_cache", "reset_cache",
     "POLICY_ORDER", "WorkloadKey", "attention_candidates",
     "estimate_gpt_step_hbm", "prune_static", "schedule_candidates",
-    "serving_candidates", "PreflightRejected", "flagship_dims",
-    "flagship_static_demo", "tune_gpt_step", "tune_serving_decode",
+    "serving_candidates", "spec_candidates", "PreflightRejected",
+    "flagship_dims", "flagship_static_demo", "tune_gpt_step",
+    "tune_serving_decode", "tune_spec_decode",
     "tune_mode", "attention_config", "schedule_config_for",
-    "serving_decode_config", "forced_attention_config", "tune_stats",
+    "serving_decode_config", "spec_decode_config",
+    "forced_attention_config", "tune_stats",
     "COSTMODEL_SCHEMA_VERSION", "CostModel", "costmodel_enabled",
     "costmodel_path", "fit_and_save", "fit_cost_model", "get_model",
     "model_status", "reset_model",
@@ -156,6 +158,19 @@ def serving_decode_config(max_len, d_head, n_head, dtype):
     if max_len is None or int(max_len) <= 0:
         return None
     return _cache_lookup("serving_decode", max_len, d_head, n_head,
+                         dtype, remat="-")
+
+
+def spec_decode_config(max_len, d_head, n_head, dtype):
+    """Hot-path lookup for ``serving.ServingEngine``'s speculative
+    draft window: the tuned ``{"k"}`` for one serving shape (workload
+    key ``op=spec_decode``, keyed on the slot KV capacity ``max_len``
+    like ``serving_decode``), or None — the engine keeps the
+    hand-picked default.  Explicit ``spec_k`` always wins (the engine
+    only calls this when given a draft but no window)."""
+    if max_len is None or int(max_len) <= 0:
+        return None
+    return _cache_lookup("spec_decode", max_len, d_head, n_head,
                          dtype, remat="-")
 
 

@@ -31,10 +31,10 @@ from ..observability import trace as _trace
 from .cache import get_cache
 from .space import (
     POLICY_ORDER, WorkloadKey, estimate_gpt_step_hbm, prune_static,
-    schedule_candidates, serving_candidates)
+    schedule_candidates, serving_candidates, spec_candidates)
 
-__all__ = ["tune_gpt_step", "tune_serving_decode", "flagship_static_demo",
-           "flagship_dims", "PreflightRejected"]
+__all__ = ["tune_gpt_step", "tune_serving_decode", "tune_spec_decode",
+           "flagship_static_demo", "flagship_dims", "PreflightRejected"]
 
 
 class PreflightRejected(Exception):
@@ -434,6 +434,107 @@ def tune_serving_decode(params, n_layer, n_head, d_model, max_len,
     win = min(measured, key=lambda m: m["median_s"])
     config = {"chunk": win["chunk"], "min_bucket": win["min_bucket"]}
     meas = {"median_s": win["median_s"], "tok_s": win["tok_s"],
+            "worst_median_s": max(m["median_s"] for m in measured),
+            "measured_candidates": len(measured)}
+    entry = cache.put(key.s, config, measured=meas)
+    cache.save()
+    tracer.instant("tune.winner", cat="tune", key=key.s, **config)
+    report.update(entry=entry, source="search")
+    return report
+
+
+def tune_spec_decode(params, draft_params, n_layer, n_head, d_model,
+                     max_len, dtype=None, draft_n_layer=None,
+                     max_slots=4, requests=6, prompt_len=5, max_new=8,
+                     ks=(1, 2, 3, 4, 6, 8), max_measure=5, force=False,
+                     mode=None, seed=0):
+    """Search (or serve from cache) the speculative draft window ``k``
+    for one serving shape — the ``op=spec_decode`` tunable
+    (docs/autotune.md "Adding a tunable op").  The right ``k`` is a
+    property of the WORKLOAD, not the model alone: it trades k + 1
+    cheap draft steps against one verify forward that amortizes a
+    target weight read over k + 1 positions, scaled by however often
+    this draft actually agrees with this target — so each candidate
+    builds a real speculative engine, serves a fixed synthetic
+    workload, and is timed wall-to-wall.  The winner's ``{"k"}``
+    persists under ``op=spec_decode|t=<max_len>|...|remat=-`` and
+    ``ServingEngine`` consults it when constructed with a draft but no
+    explicit ``spec_k``.  In mode "cached" (default) a miss NEVER
+    builds an engine — the hand-picked default applies."""
+    from . import tune_mode  # late: __init__ imports this module
+
+    import jax
+
+    reg = _obs.get_registry()
+    if dtype is None:
+        from ..models.transformer import infer_compute_dtype
+
+        dtype = str(np.dtype(infer_compute_dtype(params)))
+    key = WorkloadKey("spec_decode", max_len, d_model // n_head,
+                      n_head, dtype, jax.default_backend(), remat="-")
+    mode = mode or tune_mode()
+    report = {"key": key.s, "mode": mode, "entry": None, "source": "miss",
+              "candidates": 0, "measured": []}
+    if mode == "off":
+        report["source"] = "off"
+        return report
+    cache = get_cache()
+    hit = cache.get(key.s)
+    if hit is not None and not force:
+        reg.counter("tune.cache_hits",
+                    help="tuned-config cache lookups served").inc()
+        report.update(entry=hit, source="cache")
+        return report
+    reg.counter("tune.cache_misses",
+                help="tuned-config cache lookups missed").inc()
+    if mode != "search":
+        return report
+
+    reg.counter("tune.searches",
+                help="measured schedule searches executed").inc()
+    from ..serving import ServingEngine
+
+    cands = spec_candidates(max_len, ks=ks)
+    report["candidates"] = len(cands)
+    if max_measure and len(cands) > max_measure:
+        report["truncated_to"] = max_measure
+        cands = cands[:max_measure]
+    rng = np.random.default_rng(seed)
+    vocab = int(np.asarray(params["tok_emb.w"]).shape[0])
+    prompts = [rng.integers(1, vocab, (prompt_len,)).astype(np.int32)
+               for _ in range(requests)]
+    tracer = _trace.get_tracer()
+    measured = []
+    for i, cand in enumerate(cands):
+        with tracer.span("tune.search", cat="tune", key=key.s,
+                         candidate=i, **cand) as sp:
+            eng = ServingEngine(
+                params, n_layer, n_head, d_model, max_len=max_len,
+                max_slots=max_slots, prefix_reuse=False,
+                draft_params=draft_params, draft_n_layer=draft_n_layer,
+                spec_k=cand["k"])
+            eng.generate_many(prompts[:1], max_new_tokens=2)  # compile
+            t0 = time.perf_counter()
+            eng.generate_many(prompts, max_new_tokens=max_new)
+            wall = time.perf_counter() - t0
+            reg.counter("tune.candidates_measured",
+                        help="schedule candidates compiled and timed").inc()
+            tok_s = requests * max_new / wall
+            acc = (eng._spec.accepted / eng._spec.proposed
+                   if eng._spec.proposed else 0.0)
+            rec = dict(cand, verdict="measured",
+                       median_s=round(wall, 6), tok_s=round(tok_s, 1),
+                       accept_rate=round(acc, 4))
+            measured.append(rec)
+            sp.set(verdict="measured", median_s=rec["median_s"])
+    report["measured"] = measured
+    if not measured:
+        report["source"] = "exhausted"
+        return report
+    win = min(measured, key=lambda m: m["median_s"])
+    config = {"k": win["k"]}
+    meas = {"median_s": win["median_s"], "tok_s": win["tok_s"],
+            "accept_rate": win["accept_rate"],
             "worst_median_s": max(m["median_s"] for m in measured),
             "measured_candidates": len(measured)}
     entry = cache.put(key.s, config, measured=meas)

@@ -32,8 +32,8 @@ from ..ops.pallas_attention import (
 
 __all__ = [
     "WorkloadKey", "attention_candidates", "schedule_candidates",
-    "serving_candidates", "prune_static", "estimate_gpt_step_hbm",
-    "POLICY_ORDER",
+    "serving_candidates", "spec_candidates", "prune_static",
+    "estimate_gpt_step_hbm", "POLICY_ORDER",
 ]
 
 # remat policies from cheapest recompute to most; "none" = no
@@ -230,6 +230,19 @@ def serving_candidates(max_len, chunks=(2, 4, 8, 16, 32),
             if 1 <= int(b) <= max_len:
                 out.append({"chunk": int(c), "min_bucket": int(b)})
     return out
+
+
+def spec_candidates(max_len, ks=(1, 2, 3, 4, 6, 8)):
+    """The ``op="spec_decode"`` candidate list: the speculative draft
+    window ``k`` — ``{"k"}`` dicts (docs/autotune.md "Adding a tunable
+    op").  The sweet spot balances draft overhead (k + 1 cheap steps)
+    against verify amortization (one target read scores k + 1
+    positions) and scales with the workload's acceptance rate, so it
+    is measured, not derived.  The static prune is pure arithmetic: a
+    window of ``max_len`` or more can never commit fully (a request
+    always holds at least one prompt token), so it only wastes draft
+    steps."""
+    return [{"k": int(k)} for k in ks if 1 <= int(k) < max_len]
 
 
 def _vmem_bytes(cand, d_head, n_head, dtype_size=2):

@@ -166,6 +166,21 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     cat /tmp/_t1_attr.log >&2
     exit 1
 fi
+# speculative-decoding smoke: draft-model propose / single-pass target
+# verify / token-exact rollback on the paged serving engine — a
+# depth-pruned draft emits TOKEN-EXACT output vs single-stream greedy
+# (f32 + bf16, prefix reuse on/off), a self-draft run's acceptance ~1
+# proves the parallel verify window bit-consistent with the sequential
+# step, an adversarial draft stays exact, propose/rollback leaves
+# blocks_in_use at the plain engine's baseline, and PADDLE_TPU_SPEC=0
+# is bit-exact with zero spec metrics (docs/serving.md)
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python -m paddle_tpu --spec-selftest \
+        > /tmp/_t1_spec.log 2>&1; then
+    echo "TIER1 REGRESSION: spec selftest failed" >&2
+    cat /tmp/_t1_spec.log >&2
+    exit 1
+fi
 # bench-history gate: every BENCH_*/MULTICHIP_* artifact in the repo
 # must classify (failures acknowledged in tools/bench_known_failures.json
 # with a root cause, never silent) and no tracked metric may regress
@@ -197,10 +212,12 @@ fi
 # single-stream baseline, SLO-scheduled goodput must beat the FIFO
 # baseline's goodput under the same shared-prefix Poisson load, and the
 # paged prefix-reuse cache must hit (prefix_hit_rate > 0, strictly fewer
-# prefill tokens than reuse-off) — all asserted inside --smoke — and the
-# script must print ONE parseable JSON row with the
-# throughput/latency/goodput/prefix/compile fields
-if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+# prefill tokens than reuse-off), and the speculative pass must beat the
+# SLO pass's goodput on the same arrival schedule with zero scratch-block
+# leak — all asserted inside --smoke — and the script must print ONE
+# parseable JSON row with the throughput/latency/goodput/prefix/compile/
+# speculative fields
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
         python benchmarks/serving.py --smoke \
         > /tmp/_t1_serving.json 2> /tmp/_t1_serving.log; then
     echo "TIER1 REGRESSION: serving smoke failed" >&2
@@ -217,7 +234,8 @@ for k in ('tok_s', 'baseline_tok_s', 'speedup', 'ttft_p50_ms',
           'e2e_p99_ms', 'prefill_compiles', 'decode_compiles',
           'goodput_under_slo', 'slo_violations', 'prefix_hit_rate',
           'shed_total', 'fifo_goodput_under_slo', 'prefill_tokens',
-          'fifo_prefill_tokens', 'cow_copies'):
+          'fifo_prefill_tokens', 'cow_copies',
+          'spec_goodput_under_slo', 'spec_accept_rate', 'spec_speedup'):
     assert k in row, f'missing field {k}: {row}'
 print('serving smoke:', json.dumps(row))
 "; then
