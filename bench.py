@@ -819,7 +819,14 @@ def serving_rows(extra, timeout=900):
                           "serving_spec_goodput_under_slo"),
                          ("spec_accept_rate",
                           "serving_spec_accept_rate"),
-                         ("spec_speedup", "serving_spec_speedup")):
+                         ("spec_speedup", "serving_spec_speedup"),
+                         ("serving_decode_hbm_bytes",
+                          "serving_decode_hbm_bytes"),
+                         ("serving_attn_bytes", "serving_attn_bytes"),
+                         ("serving_decode_hbm_bytes_gather",
+                          "serving_decode_hbm_bytes_gather"),
+                         ("serving_attn_bytes_gather",
+                          "serving_attn_bytes_gather")):
             if isinstance(row.get(src), (int, float)):
                 extra[dst] = row[src]
         if "serving_tok_s" not in extra:

@@ -2,7 +2,8 @@
 (docs/kernels.md, ROADMAP item 2).
 
 One op, three targets, one numerics oracle: every fused op class
-(``flash_attention``, ``fused_ce``, ``decode_gather``) resolves through
+(``flash_attention``, ``fused_ce``, ``decode_gather``,
+``paged_attention``) resolves through
 :mod:`.registry` to one of ``pallas_tpu`` (the Mosaic kernels — native
 on TPU, interpret mode in CPU tests), ``triton`` (the same block
 schedules lowered GPU-style — :mod:`.triton_attention` /
@@ -28,6 +29,7 @@ from .xla_ref import ORACLE_TOL, oracle_tol
 from . import xla_ref  # registers the oracle backend
 from . import triton_attention, triton_ce  # register the GPU backends
 from . import pallas_gather  # registers the TPU decode gather
+from . import paged_attention  # registers the paged-attention op class
 
 __all__ = [
     "AUTO_ORDER", "BACKENDS", "GLOBAL_ENV", "TIMED_RUN_ENV",

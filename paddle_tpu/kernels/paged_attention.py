@@ -1,0 +1,345 @@
+"""Block-table-aware paged attention — the fourth op class.
+
+The serving engine's paged KV cache (``serving/kvcache.py``) stores
+each slot's KV as a chain of physical blocks named by a block table;
+until this op class existed every decode step materialized the full
+logical view ``pool[table] -> [S, T, h, dh]`` through ``decode_gather``
+before attention ran, so HBM traffic and peak memory scaled with the
+padded table capacity ``T``, not the tokens actually live in a chain.
+``paged_attention`` attends THROUGH the table instead: online-softmax
+block by block, one physical block (or a small group) in flight at a
+time, the gathered view never built.
+
+Calling convention (all backends)::
+
+    call(q, pool_k, pool_v, table, pos, block_step=None,
+         interpret=None) -> ctx
+
+    q       [S, W, h, dh]   query window (W=1 for plain decode,
+                            W=k+1 for the speculative verify window)
+    pool_k  [num_blocks, B, h, dh]   the physical K pool (one layer)
+    pool_v  [num_blocks, B, h, dh]   the physical V pool
+    table   [S, NB] int32   per-slot block chain (block 0 = trash)
+    pos     [S, W]  int32   absolute position of each query; key token
+                            ``j`` participates iff ``j <= pos`` — the
+                            same write-before-attend mask the gather
+                            spelling applies, so trash-block garbage,
+                            bucket padding and CoW tails all carry
+                            exactly zero attention weight
+    ctx     [S, W, h, dh]   in ``q.dtype``
+
+Numerics conventions match the flash kernels (f32 scores via
+``preferred_element_type``, ``NEG_INF`` masking, f32 ``(m, l, acc)``
+online-softmax state, one normalization at the end with the
+``l == 0 -> 1`` guard, output cast to the input dtype).  The blocked
+reassociation means results differ from the dense gather+softmax
+spelling within ``ORACLE_TOL["paged_attention", ...]``; within one
+backend the op is bit-exact run to run.  Token position ``nb*B + b``
+of slot ``s`` lives at ``(table[s, nb], b)`` — block 0 never needs
+zeroing because its token positions in an unused table entry are
+always ``> pos``.
+
+Backends:
+
+* ``xla_ref`` — a ``lax.scan`` over table entries, gathering
+  ``block_step`` physical blocks per step (``[S, block_step*B, h,
+  dh]`` in flight — the tuned block-iteration geometry,
+  ``tune.paged_attention_config``).  The universal numerics reference.
+* ``pallas_tpu`` — ``PrefetchScalarGridSpec`` scalar prefetch (the
+  ``pallas_gather.py`` spelling): the table feeds the K/V BlockSpec
+  index maps, so each sequential grid step DMAs exactly one physical
+  block into VMEM while ``(m, l, acc)`` carry in VMEM scratch.
+  Registered available on real TPU only (off-TPU the interpret-mode
+  grid would replace one fused XLA loop with a per-block Python loop);
+  the oracle suite still covers the kernel logic on CPU by forcing
+  ``interpret=True``.
+* ``triton`` — the GPU decomposition of ``triton_attention.py``: a
+  parallel grid over independent slots, the block-chain reduction as a
+  ``lax.fori_loop`` inside the kernel with ``pl.load`` +
+  ``pl.dslice`` dynamic block fetches.  Interpret-verified on CPU.
+
+``serving/batched_decode.py`` routes here when ``PADDLE_TPU_PAGED_ATTN``
+is on (the default); ``=0`` restores the gather+flash spelling
+bit-exact (docs/serving.md "Paged KV cache").
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_kernel
+from .triton_attention import _default_interpret, _gpu_available
+from .xla_ref import NEG_INF
+
+
+def _normalize_block_step(block_step, nb, w=1):
+    if block_step is None:
+        # measured default (tune_paged_attention owns the per-workload
+        # override): single-token decode (W=1) is fastest streaming one
+        # block per step and that is also where the memory win lives;
+        # multi-token windows (the speculative verify, W=k+1) pay the
+        # scan's sequential dispatch W times over and win by consuming
+        # the whole chain in one wide step instead
+        block_step = 1 if w == 1 else nb
+    return max(1, min(int(block_step), nb))
+
+
+# -- xla_ref: the block-scan oracle ------------------------------------------
+
+def paged_attention_ref(q, pool_k, pool_v, table, pos, block_step=None,
+                        interpret=None):
+    """The oracle spelling: ``lax.scan`` over the block chain with
+    online-softmax carry — per step only ``block_step`` physical blocks
+    are gathered (``[S, block_step*B, h, dh]``), never the ``T``-wide
+    view.  ``interpret`` is accepted for signature parity and ignored
+    (no Pallas here)."""
+    del interpret
+    S, W, h, dh = q.shape
+    B = pool_k.shape[1]
+    NB = table.shape[1]
+    T = NB * B
+    bs = _normalize_block_step(block_step, NB, W)
+    pad = (-NB) % bs
+    tbl = table.astype(jnp.int32)
+    if pad:
+        # pad the chain with trash-block entries; their token positions
+        # (>= T) are unconditionally masked below
+        tbl = jnp.concatenate(
+            [tbl, jnp.zeros((S, pad), jnp.int32)], axis=1)
+    scale = 1.0 / float(dh) ** 0.5
+    off = jnp.arange(bs * B, dtype=jnp.int32)
+
+    if (NB + pad) // bs == 1:
+        # one step consumes the whole chain: skip the scan and its
+        # renormalization carry — a single masked softmax over the
+        # one gathered [S, bs*B, h, dh] group (same NEG_INF masking,
+        # same l==0 guard; this is what the scan would compute, minus
+        # the dead alpha/acc-renorm work of a length-1 carry)
+        kb = pool_k[tbl].reshape(S, (NB + pad) * B, h, dh)
+        vb = pool_v[tbl].reshape(S, (NB + pad) * B, h, dh)
+        s = jnp.einsum("swhd,sthd->swht", q, kb,
+                       preferred_element_type=jnp.float32) * scale
+        keep = ((off[None, None, None, :] <= pos[:, :, None, None])
+                & (off < T)[None, None, None, :])
+        s = jnp.where(keep, s, NEG_INF)
+        p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+        l = jnp.sum(p, axis=-1)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        ctx = jnp.einsum("swht,sthd->swhd", p, vb.astype(jnp.float32))
+        return (ctx / l_safe[..., None]).astype(q.dtype)
+
+    def step(carry, i):
+        m, l, acc = carry
+        blk = jax.lax.dynamic_slice_in_dim(tbl, i * bs, bs, 1)  # [S, bs]
+        kb = pool_k[blk].reshape(S, bs * B, h, dh)
+        vb = pool_v[blk].reshape(S, bs * B, h, dh)
+        tok = i * (bs * B) + off                                # [bs*B]
+        s = jnp.einsum("swhd,sthd->swht", q, kb,
+                       preferred_element_type=jnp.float32) * scale
+        keep = ((tok[None, None, None, :] <= pos[:, :, None, None])
+                & (tok < T)[None, None, None, :])
+        s = jnp.where(keep, s, NEG_INF)
+        m2 = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m2)
+        p = jnp.exp(s - m2[..., None])
+        l2 = l * alpha + jnp.sum(p, axis=-1)
+        acc2 = acc * alpha[..., None] + jnp.einsum(
+            "swht,sthd->swhd", p, vb.astype(jnp.float32))
+        return (m2, l2, acc2), None
+
+    m0 = jnp.full((S, W, h), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((S, W, h), jnp.float32)
+    a0 = jnp.zeros((S, W, h, dh), jnp.float32)
+    nsteps = (NB + pad) // bs
+    (m, l, acc), _ = jax.lax.scan(
+        step, (m0, l0, a0), jnp.arange(nsteps, dtype=jnp.int32))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+# -- pallas_tpu: scalar-prefetch block streaming -----------------------------
+
+def paged_attention_pallas(q, pool_k, pool_v, table, pos, block_step=None,
+                           interpret=None):
+    """``PrefetchScalarGridSpec`` kernel: grid ``(S, NB)``, the block
+    TABLE is the scalar-prefetch argument consumed by the K/V BlockSpec
+    index maps, so grid step ``(s, nb)`` streams physical block
+    ``table[s, nb]`` into VMEM.  TPU grids run sequentially, so the
+    online-softmax state carries across ``nb`` steps in VMEM scratch
+    and the output writes once at the last step.  ``block_step`` is
+    accepted for signature parity and ignored — this spelling streams
+    exactly one block per grid step by construction."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    del block_step
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    S, W, h, dh = q.shape
+    B = pool_k.shape[1]
+    NB = table.shape[1]
+    T = NB * B
+    scale = 1.0 / float(dh) ** 0.5
+
+    def kernel(tbl, q_ref, k_ref, v_ref, pos_ref, o_ref,
+               m_ref, l_ref, acc_ref):
+        del tbl  # consumed by the index maps, not the body
+        nb = pl.program_id(1)
+
+        @pl.when(nb == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        qw = q_ref[0]                                      # [W, h, dh]
+        kb = k_ref[0]                                      # [B, h, dh]
+        vb = v_ref[0]
+        pw = pos_ref[0]                                    # [W]
+        s = jnp.einsum("whd,bhd->whb", qw, kb,
+                       preferred_element_type=jnp.float32) * scale
+        tok = nb * B + jax.lax.broadcasted_iota(jnp.int32, (1, 1, B), 2)
+        keep = tok <= pw[:, None, None]
+        s = jnp.where(keep, s, NEG_INF)
+        m = m_ref[...]
+        m2 = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m2)
+        p = jnp.exp(s - m2[..., None])
+        m_ref[...] = m2
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + jnp.einsum(
+            "whb,bhd->whd", p, vb.astype(jnp.float32))
+
+        @pl.when(nb == NB - 1)
+        def _finish():
+            l = l_ref[...]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[...] = (acc_ref[...]
+                          / l_safe[..., None]).astype(o_ref.dtype)[None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S, NB),
+        in_specs=[
+            pl.BlockSpec((1, W, h, dh), lambda s, nb, tbl: (s, 0, 0, 0)),
+            pl.BlockSpec((1, B, h, dh),
+                         lambda s, nb, tbl: (tbl[s, nb], 0, 0, 0)),
+            pl.BlockSpec((1, B, h, dh),
+                         lambda s, nb, tbl: (tbl[s, nb], 0, 0, 0)),
+            pl.BlockSpec((1, W), lambda s, nb, tbl: (s, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, W, h, dh), lambda s, nb, tbl: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((W, h), jnp.float32),
+            pltpu.VMEM((W, h), jnp.float32),
+            pltpu.VMEM((W, h, dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, W, h, dh), q.dtype),
+        compiler_params=dict(
+            mosaic=dict(dimension_semantics=("arbitrary", "arbitrary"))),
+        interpret=bool(interpret),
+    )(table.astype(jnp.int32), q, pool_k, pool_v, pos.astype(jnp.int32))
+
+
+def _tpu_available():
+    try:
+        backend = jax.default_backend()
+    except Exception as e:  # noqa: BLE001
+        return False, f"jax backend probe failed: {e}"
+    if backend == "tpu":
+        return True, ""
+    return False, (f"not on TPU (platform {backend!r}); the block-scan "
+                   f"XLA oracle is the efficient spelling here")
+
+
+# -- triton: parallel slots, fori_loop block chain ---------------------------
+
+def paged_attention_triton(q, pool_k, pool_v, table, pos, block_step=None,
+                           interpret=None):
+    """GPU-style decomposition (``triton_attention.py`` structure): the
+    grid covers only independent cells (one slot each — slots share
+    nothing), and the block-chain reduction runs INSIDE the kernel as a
+    ``lax.fori_loop`` whose body ``pl.load``s the physical block the
+    table names via a dynamic ``pl.dslice``.  ``block_step`` is
+    accepted for signature parity and ignored — the loop consumes one
+    physical block per iteration."""
+    import jax.experimental.pallas as pl
+
+    del block_step
+    interpret = _default_interpret(interpret)
+    S, W, h, dh = q.shape
+    num_blocks, B = pool_k.shape[0], pool_k.shape[1]
+    NB = table.shape[1]
+    scale = 1.0 / float(dh) ** 0.5
+
+    def kernel(q_ref, k_ref, v_ref, tbl_ref, pos_ref, o_ref):
+        qw = q_ref[0]                                      # [W, h, dh]
+        pw = pos_ref[0]                                    # [W]
+
+        def body(nb, carry):
+            m, l, acc = carry
+            blk = pl.load(tbl_ref, (pl.dslice(0, 1),
+                                    pl.dslice(nb, 1)))[0, 0]
+            kb = pl.load(k_ref, (pl.dslice(blk, 1), slice(None),
+                                 slice(None), slice(None)))[0]
+            vb = pl.load(v_ref, (pl.dslice(blk, 1), slice(None),
+                                 slice(None), slice(None)))[0]
+            s = jnp.einsum("whd,bhd->whb", qw, kb,
+                           preferred_element_type=jnp.float32) * scale
+            tok = nb * B + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, B), 2)
+            s = jnp.where(tok <= pw[:, None, None], s, NEG_INF)
+            m2 = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m2)
+            p = jnp.exp(s - m2[..., None])
+            l2 = l * alpha + jnp.sum(p, axis=-1)
+            acc2 = acc * alpha[..., None] + jnp.einsum(
+                "whb,bhd->whd", p, vb.astype(jnp.float32))
+            return m2, l2, acc2
+
+        m0 = jnp.full((W, h), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((W, h), jnp.float32)
+        a0 = jnp.zeros((W, h, dh), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, NB, body, (m0, l0, a0))
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc / l_safe[..., None]).astype(o_ref.dtype)[None]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, W, h, dh), lambda s: (s, 0, 0, 0)),
+            pl.BlockSpec((num_blocks, B, h, dh), lambda s: (0, 0, 0, 0)),
+            pl.BlockSpec((num_blocks, B, h, dh), lambda s: (0, 0, 0, 0)),
+            pl.BlockSpec((1, NB), lambda s: (s, 0)),
+            pl.BlockSpec((1, W), lambda s: (s, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, W, h, dh), lambda s: (s, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((S, W, h, dh), q.dtype),
+        interpret=bool(interpret),
+    )(q, pool_k, pool_v, table.astype(jnp.int32), pos.astype(jnp.int32))
+
+
+# -- registration ------------------------------------------------------------
+
+class _PagedXlaRef:
+    call = staticmethod(paged_attention_ref)
+
+
+class _PagedPallasTpu:
+    call = staticmethod(paged_attention_pallas)
+
+
+class _PagedTriton:
+    call = staticmethod(paged_attention_triton)
+
+
+register_kernel("paged_attention", "xla_ref", _PagedXlaRef)
+register_kernel("paged_attention", "pallas_tpu", _PagedPallasTpu,
+                available=_tpu_available)
+register_kernel("paged_attention", "triton", _PagedTriton,
+                available=_gpu_available)

@@ -13,10 +13,17 @@ What it proves on THIS host, accelerator or not:
    within the documented ``ORACLE_TOL`` bounds, f32 + bf16, causal +
    non-causal, d_head 64/128, grads through the custom-vjp — and is
    BIT-EXACT run-to-run within itself;
-3. the xla_ref acceptance bar — ``PADDLE_TPU_KERNEL_BACKEND=xla_ref``
+3. paged-attention parity — every runnable backend of the
+   ``paged_attention`` op class (interpret-forced where unavailable)
+   matches a dense gather+softmax reference within ``ORACLE_TOL``
+   over ragged chains (fully-cached one-token prefill, a CoW fork,
+   trash-block garbage), is bit-exact run-to-run, and the
+   ``PADDLE_TPU_PAGED_ATTN`` kill switch provably toggles which
+   spelling the serving decode chunk compiles;
+4. the xla_ref acceptance bar — ``PADDLE_TPU_KERNEL_BACKEND=xla_ref``
    runs the full GPT trainer path under EVERY memory_optimize policy
    with ZERO Pallas calls in the traced jaxpr and a finite loss;
-4. the timed-run lint — a timed-run region compiled with interpret-mode
+5. the timed-run lint — a timed-run region compiled with interpret-mode
    kernels plants a ``jaxpr.kernel-backend`` error and the same region
    routed to xla_ref compiles clean.
 """
@@ -44,7 +51,8 @@ def _check_registry(failures):
     ops = registered_op_classes()
     print(f"registry: op classes {ops} on platform "
           f"{jax.default_backend()!r}")
-    if sorted(ops) != ["decode_gather", "flash_attention", "fused_ce"]:
+    if sorted(ops) != ["decode_gather", "flash_attention", "fused_ce",
+                       "paged_attention"]:
         failures.append(f"unexpected op classes: {ops}")
     for op in ops:
         auto = resolve_name(op)
@@ -260,6 +268,198 @@ def _check_oracle(failures):
     print("run-to-run bit-exactness ok")
 
 
+def _paged_impls():
+    """(name, fn(q, pk, pv, table, pos) -> ctx) for every backend whose
+    paged-attention logic can run on this host — available ones as the
+    registry would run them, plus the GPU/TPU kernels force-run in
+    interpret mode (the blocked online-softmax logic is the thing under
+    test, accelerator or not)."""
+    from . import available_backends, get_kernel
+
+    out = []
+    for b, ok, _ in available_backends("paged_attention"):
+        impl = get_kernel("paged_attention", b).impl
+        if b == "xla_ref":
+            # the oracle itself re-runs at several block_steps (None =
+            # the W-aware default, including the one-step no-scan
+            # path): the cross-block carry must not depend on the
+            # iteration grouping
+            for bs in (None, 1, 3):
+                out.append((f"xla_ref(bs={bs or 'auto'})",
+                            lambda q, k, v, t, p, i=impl, s=bs: i.call(
+                                q, k, v, t, p, block_step=s)))
+        elif ok:
+            out.append((b, lambda q, k, v, t, p, i=impl: i.call(
+                q, k, v, t, p)))
+        else:
+            out.append((b + "(interpret)",
+                        lambda q, k, v, t, p, i=impl: i.call(
+                            q, k, v, t, p, interpret=True)))
+    return out
+
+
+def _paged_dense_ref(q, pool_k, pool_v, table, pos):
+    """The independent spelling the kernels must match: materialize the
+    gathered [S, T, h, dh] view (exactly what the paged kernel exists
+    to avoid), dense-mask past ``pos``, one softmax — all f32."""
+    import jax.numpy as jnp
+
+    from .xla_ref import NEG_INF, decode_gather
+
+    S, NB = table.shape
+    B = pool_k.shape[1]
+    dh = q.shape[-1]
+    kg = decode_gather(pool_k, table).astype(jnp.float32)
+    vg = decode_gather(pool_v, table).astype(jnp.float32)
+    s = jnp.einsum("swhd,sthd->swht", q.astype(jnp.float32), kg)
+    s = s / jnp.sqrt(jnp.float32(dh))
+    tok = jnp.arange(NB * B, dtype=jnp.int32)
+    keep = tok[None, None, None, :] <= pos[:, :, None, None]
+    s = jnp.where(keep, s, NEG_INF)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("swht,sthd->swhd", p, vg).astype(q.dtype)
+
+
+def _check_paged_oracle(failures):
+    import jax
+    import jax.numpy as jnp
+
+    from . import oracle_tol
+
+    rng = np.random.default_rng(23)
+    impls = _paged_impls()
+    print(f"oracle parity (paged attention): backends "
+          f"{[n for n, _ in impls]} vs dense gather+softmax")
+    S, NB, B, h, dh = 4, 3, 4, 2, 16
+    num_blocks = 1 + S * NB
+    # ragged chains: a full slot, a mid-chain decode, a fully-cached
+    # one-token prefill (pos = plen-1 with plen < capacity), and a CoW
+    # fork — slot 3 shares slot 0's first block id, diverges after
+    table = np.arange(1, 1 + S * NB, dtype=np.int32).reshape(S, NB)
+    table[3, 0] = table[0, 0]
+    table[2, 2] = 0          # unused tail -> trash block (masked)
+    pos_cases = (
+        ("decode", 1, np.array([[NB * B - 1], [5], [7], [9]], np.int32)),
+        ("cached-prefill", 1, np.array([[3], [0], [6], [4]], np.int32)),
+        ("verify-window", 3,
+         np.array([[4, 5, 6], [1, 2, 3], [5, 6, 7], [8, 9, 10]],
+                  np.int32)),
+    )
+    for dt in (jnp.float32, jnp.bfloat16):
+        dt_name = str(jnp.dtype(dt))
+        pool_k = jnp.asarray(
+            rng.normal(size=(num_blocks, B, h, dh)) * 0.5, dt)
+        pool_v = jnp.asarray(
+            rng.normal(size=(num_blocks, B, h, dh)) * 0.5, dt)
+        # trash block 0 holds garbage, as in the live engine: masking,
+        # not zeroing, must keep it out of every context
+        pool_k = pool_k.at[0].set(1e3)
+        pool_v = pool_v.at[0].set(1e3)
+        tol = oracle_tol("paged_attention", dt_name, "fwd")
+        for case, W, pos in pos_cases:
+            q = jnp.asarray(rng.normal(size=(S, W, h, dh)) * 0.5, dt)
+            tbl = jnp.asarray(table)
+            p = jnp.asarray(pos)
+            ref = _paged_dense_ref(q, pool_k, pool_v, tbl, p)
+            for name, fn in impls:
+                err = _rel_err(fn(q, pool_k, pool_v, tbl, p), ref)
+                if err > tol:
+                    failures.append(
+                        f"paged {name} {dt_name} {case}: fwd err "
+                        f"{err:.2e} > {tol}")
+    print("paged parity ok (incl. trash-block masking, CoW fork)")
+
+    # run-to-run bit-exactness WITHIN a backend
+    q = jnp.asarray(rng.normal(size=(S, 1, h, dh)) * 0.5, jnp.float32)
+    pool_k = jnp.asarray(
+        rng.normal(size=(num_blocks, B, h, dh)), jnp.float32)
+    pool_v = jnp.asarray(
+        rng.normal(size=(num_blocks, B, h, dh)), jnp.float32)
+    tbl = jnp.asarray(table)
+    p = jnp.asarray([[5], [7], [9], [11]], np.int32)
+    for name, fn in impls:
+        jf = jax.jit(fn)
+        a, b2 = jf(q, pool_k, pool_v, tbl, p), jf(q, pool_k, pool_v,
+                                                  tbl, p)
+        if not bool(jnp.array_equal(a, b2)):
+            failures.append(f"paged {name}: not bit-exact run-to-run")
+    print("paged run-to-run bit-exactness ok")
+
+    # the PADDLE_TPU_PAGED_ATTN kill switch: =0 compiles the serving
+    # decode step through decode_gather (the pre-paged spelling,
+    # bit-exact with itself across compiles), =1 through the paged
+    # kernel; both spellings agree numerically
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import batched_decode as _bd
+
+    pt.core.unique_name.reset()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        transformer.build(vocab_size=64, n_layer=1, n_head=2,
+                          d_model=32, max_len=16, dropout_rate=0.0)
+    scope = pt.core.scope.Scope()
+    pt.core.scope._scope_stack.append(scope)
+    try:
+        exe = pt.Executor()
+        exe.run(startup, scope=scope)
+        params = transformer.extract_params(program=main, scope=scope)
+    finally:
+        pt.core.scope._scope_stack.pop()
+    pdev = {k: jnp.asarray(v) for k, v in params.items()}
+    S2, NB2, B2 = 2, 4, 4
+    nb2 = 1 + S2 * NB2
+    pk = (jnp.asarray(rng.normal(size=(nb2, B2, 2, 16)) * 0.1,
+                      jnp.float32),)
+    pv = (jnp.asarray(rng.normal(size=(nb2, B2, 2, 16)) * 0.1,
+                      jnp.float32),)
+    tok = jnp.asarray([3, 5], jnp.int32)
+    t = jnp.asarray([6, 9], jnp.int32)
+    tbl2 = jnp.asarray(1 + np.arange(S2 * NB2).reshape(S2, NB2),
+                       np.int32)
+    prev = os.environ.get("PADDLE_TPU_PAGED_ATTN")
+    try:
+        outs = {}
+        for env in ("0", "1"):
+            os.environ["PADDLE_TPU_PAGED_ATTN"] = env
+            fn = _bd.make_decode_chunk(1, 2, 32, 2, donate=False)
+            # the compiled module keeps op metadata (source_file /
+            # named_scope op_name); the StableHLO dump does not
+            text = fn.lower(pdev, pk, pv, tok, t, tbl2).compile() \
+                     .as_text()
+            spelled = ("decode_gather" in text if env == "0"
+                       else "paged_attention" in text)
+            if not spelled:
+                failures.append(
+                    f"PADDLE_TPU_PAGED_ATTN={env}: expected spelling "
+                    f"absent from the lowered decode chunk")
+            outs[env] = fn(pdev, pk, pv, tok, t, tbl2)
+            again = fn(pdev, pk, pv, tok, t, tbl2)
+            for a, b2_ in zip(jax.tree_util.tree_leaves(outs[env]),
+                              jax.tree_util.tree_leaves(again)):
+                if not bool(jnp.array_equal(a, b2_)):
+                    failures.append(
+                        f"PADDLE_TPU_PAGED_ATTN={env}: decode chunk "
+                        f"not bit-exact across calls")
+                    break
+        # outputs are (pool_k', pool_v', last', pos', toks): greedy
+        # token equality is the spelling-equivalence bar (float pools
+        # may differ in reassociation low bits between the spellings)
+        toks0, toks1 = outs["0"][4], outs["1"][4]
+        if not bool(jnp.array_equal(toks0, toks1)):
+            failures.append(
+                "kill switch: paged vs gather decode chunks sampled "
+                "different tokens")
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_PAGED_ATTN", None)
+        else:
+            os.environ["PADDLE_TPU_PAGED_ATTN"] = prev
+    print("kill switch ok: =0 compiles decode_gather, =1 compiles "
+          "paged_attention, same tokens")
+
+
 def _check_xla_ref_trainer(failures):
     import jax
 
@@ -377,7 +577,7 @@ def _check_timed_run_lint(failures):
 
 def run_selftest():
     failures = []
-    for check in (_check_registry, _check_oracle,
+    for check in (_check_registry, _check_oracle, _check_paged_oracle,
                   _check_xla_ref_trainer, _check_timed_run_lint):
         try:
             check(failures)

@@ -47,6 +47,11 @@ ORACLE_TOL = {
     # a gather moves bits, it does not compute: exact in every dtype
     ("decode_gather", "float32"): {"fwd": 0.0, "grad": 0.0},
     ("decode_gather", "bfloat16"): {"fwd": 0.0, "grad": 0.0},
+    # paged attention is inference-only (no VJP): fwd bounds match
+    # flash_attention — the same blocked online-softmax reassociation
+    # against the same dense-softmax reference, per block chain
+    ("paged_attention", "float32"): {"fwd": 2e-4, "grad": None},
+    ("paged_attention", "bfloat16"): {"fwd": 2e-2, "grad": None},
 }
 
 
@@ -304,11 +309,21 @@ def fused_softmax_ce_head_with_lse(x, w, labels, block_n=None,
 def decode_gather(pool, table):
     """``pool [num_blocks, B, h, dh]``, ``table [S, NB]`` int32 ->
     each slot's logical KV view ``[S, NB*B, h, dh]`` — the advanced-
-    indexing spelling (an XLA gather), today's serving code path on
-    every platform without a native kernel."""
+    indexing spelling (an XLA gather) that MATERIALIZES the per-slot
+    view in HBM.  Since the ``paged_attention`` op class landed this is
+    the kill-switch / oracle spelling (``PADDLE_TPU_PAGED_ATTN=0``) and
+    the parity reference the selftest checks the blocked kernels
+    against; the serving hot path streams pool blocks through
+    ``paged_attention`` instead and never builds this view.  The
+    ``named_scope`` keys HLO attribution: every op XLA fuses out of
+    this gather lands in the ``decode_gather`` class, so serving
+    benches can put a number on exactly the traffic the paged kernel
+    deletes."""
     S, NB = table.shape
     B = pool.shape[1]
-    return pool[table].reshape(S, NB * B, pool.shape[2], pool.shape[3])
+    with jax.named_scope("decode_gather"):
+        return pool[table].reshape(S, NB * B, pool.shape[2],
+                                   pool.shape[3])
 
 
 # -- registration ------------------------------------------------------------

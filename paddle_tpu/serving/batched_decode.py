@@ -21,9 +21,11 @@ gathers THROUGH the table, so:
 Four compiled entry points, built once per engine:
 
 * ``make_decode_chunk`` — a ``lax.scan`` of ``chunk`` batched steps
-  between host syncs; K/V for attention is gathered ``pool[table]`` per
-  layer inside the step (same bytes the contiguous spelling read — the
-  einsum always consumed the full ``[S, T, h, dh]`` view).
+  between host syncs; attention consumes the block table DIRECTLY
+  through the ``paged_attention`` op class (online softmax block by
+  block — the ``[S, T, h, dh]`` gathered view never materializes).
+  ``PADDLE_TPU_PAGED_ATTN=0`` restores the ``decode_gather`` +
+  dense-softmax spelling bit-exact (the kill switch / oracle path).
 * ``make_prefill`` — one executable per SUFFIX bucket: scans the
   non-cached tail of the prompt (``tokens[start:start+length]`` padded
   to the bucket) through the same single-token step math, starting at
@@ -52,6 +54,8 @@ decode through the paged engine is bit-identical to single-stream
 acceptance bar, ``tests/test_serving.py`` / ``tests/test_kvcache.py``).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -59,14 +63,55 @@ __all__ = ["paged_step_logits", "make_decode_chunk", "make_prefill",
            "make_verify_window"]
 
 
+def _paged_attn_on():
+    """The ``PADDLE_TPU_PAGED_ATTN`` kill switch (default ON).  Read at
+    TRACE time, so an engine built under ``=0`` compiles the
+    gather+dense-softmax spelling verbatim — bit-exact with the
+    pre-paged-attention engine."""
+    return os.environ.get("PADDLE_TPU_PAGED_ATTN", "1").lower() not in (
+        "0", "", "false", "off", "no")
+
+
 def _gather_kv(pool, table):
     """The block-table gather, routed through the kernel registry
     (``decode_gather`` op class, docs/kernels.md): the XLA
     advanced-indexing gather off-TPU, the scalar-prefetch Pallas kernel
-    on TPU.  Bit-exact across backends — a gather moves bits."""
+    on TPU.  Bit-exact across backends — a gather moves bits.
+
+    Since the ``paged_attention`` op class landed this is the
+    KILL-SWITCH / ORACLE spelling, not the fast path: attention
+    normally consumes the table directly (``_paged_attention`` below)
+    and the ``[S, T, h, dh]`` view this gather materializes exists only
+    under ``PADDLE_TPU_PAGED_ATTN=0`` (rollback) and in the reference
+    suites that pin the paged kernels' numerics against it."""
     from ..kernels import resolve
 
     return resolve("decode_gather").impl.call(pool, table)
+
+
+def _paged_attention(qh, pool_k, pool_v, table, pos):
+    """One layer's attention THROUGH the block table: resolve the
+    ``paged_attention`` op class (docs/kernels.md) and stream blocks
+    with online softmax — ``qh [S, W, h, dh]``, ``pos [S, W]`` →
+    ``[S, W, h, dh]``.  The tuned block-iteration geometry and backend
+    come from the ``op=paged_attention`` cache entry when one exists
+    (cached-mode lookup: a miss never compiles, an unavailable
+    persisted backend degrades to auto)."""
+    from .. import tune
+    from ..kernels import resolve
+
+    T = table.shape[1] * pool_k.shape[1]
+    h, dh = qh.shape[-2], qh.shape[-1]
+    try:
+        cfg = tune.paged_attention_config(T, dh, h, str(qh.dtype)) or {}
+    except Exception:  # noqa: BLE001 — tuning must never break decode
+        cfg = {}
+    try:
+        ker = resolve("paged_attention", backend=cfg.get("backend"))
+    except Exception:  # noqa: BLE001 — stale persisted backend -> auto
+        ker = resolve("paged_attention")
+    return ker.impl.call(qh, pool_k, pool_v, table, pos,
+                         block_step=cfg.get("block_step"))
 
 
 def _ln(x, scale, bias, eps):
@@ -120,17 +165,26 @@ def paged_step_logits(p, tok, t, pool_k, pool_v, table, n_layer, n_head,
         pv = pool_v[i].at[blk, off].set(vh)
         pk_out.append(pk)
         pv_out.append(pv)
-        # gather each slot's logical sequence view [S, T, h, dh]
-        # through the registry-routed decode_gather kernel
-        ck = _gather_kv(pk, table)
-        cv = _gather_kv(pv, table)
-        s = jnp.einsum("shd,sThd->shT", qh, ck,
-                       preferred_element_type=jnp.float32)
-        s = s / jnp.sqrt(float(dh))
-        mask = jnp.arange(T)[None, None, :] <= t[:, None, None]
-        s = jnp.where(mask, s, -1e30)
-        a = jax.nn.softmax(s, axis=-1).astype(ck.dtype)
-        ctx = jnp.einsum("shT,sThd->shd", a, cv).reshape(S, d_model)
+        if _paged_attn_on():
+            # attend THROUGH the table: paged_attention streams blocks
+            # with online softmax, the [S, T, h, dh] view never exists
+            ctx = _paged_attention(
+                qh[:, None], pk, pv, table,
+                t[:, None])[:, 0].reshape(S, d_model)
+        else:
+            # kill-switch spelling (PADDLE_TPU_PAGED_ATTN=0): gather
+            # each slot's logical view [S, T, h, dh] through the
+            # registry-routed decode_gather kernel, dense softmax —
+            # bit-exact with the pre-paged-attention engine
+            ck = _gather_kv(pk, table)
+            cv = _gather_kv(pv, table)
+            s = jnp.einsum("shd,sThd->shT", qh, ck,
+                           preferred_element_type=jnp.float32)
+            s = s / jnp.sqrt(float(dh))
+            mask = jnp.arange(T)[None, None, :] <= t[:, None, None]
+            s = jnp.where(mask, s, -1e30)
+            a = jax.nn.softmax(s, axis=-1).astype(ck.dtype)
+            ctx = jnp.einsum("shT,sThd->shd", a, cv).reshape(S, d_model)
         x = x + ctx @ w("att_out.w") + w("att_out.b")
         h2 = _ln(x, w("ln2.scale"), w("ln2.bias"), eps)
         # exact erf gelu, matching transformer.generate and the gelu op
@@ -231,19 +285,26 @@ def make_verify_window(n_layer, n_head, d_model, k, eps=1e-5,
             pv = pool_v[i].at[blk, off].set(vh)
             pool_k = pool_k[:i] + (pk,) + pool_k[i + 1:]
             pool_v = pool_v[:i] + (pv,) + pool_v[i + 1:]
-            ck = _gather_kv(pk, table)                       # [S, T, h, dh]
-            cv = _gather_kv(pv, table)
-            s = jnp.einsum("swhd,sThd->swhT", qh, ck,
-                           preferred_element_type=jnp.float32)
-            s = s / jnp.sqrt(float(dh))
-            # one causal mask covers the cached chain AND the
-            # in-window positions: window slot j attends <= pos + j
-            mask = (jnp.arange(T)[None, None, None, :]
-                    <= P[:, :, None, None])
-            s = jnp.where(mask, s, -1e30)
-            a = jax.nn.softmax(s, axis=-1).astype(ck.dtype)
-            ctx = jnp.einsum("swhT,sThd->swhd", a, cv).reshape(
-                S, W, d_model)
+            if _paged_attn_on():
+                # window position j attends <= pos + j — the same
+                # causal invariant, enforced per block inside the
+                # paged_attention kernel instead of over a gathered view
+                ctx = _paged_attention(qh, pk, pv, table, P).reshape(
+                    S, W, d_model)
+            else:
+                ck = _gather_kv(pk, table)                   # [S, T, h, dh]
+                cv = _gather_kv(pv, table)
+                s = jnp.einsum("swhd,sThd->swhT", qh, ck,
+                               preferred_element_type=jnp.float32)
+                s = s / jnp.sqrt(float(dh))
+                # one causal mask covers the cached chain AND the
+                # in-window positions: window slot j attends <= pos + j
+                mask = (jnp.arange(T)[None, None, None, :]
+                        <= P[:, :, None, None])
+                s = jnp.where(mask, s, -1e30)
+                a = jax.nn.softmax(s, axis=-1).astype(ck.dtype)
+                ctx = jnp.einsum("swhT,sThd->swhd", a, cv).reshape(
+                    S, W, d_model)
             x = x + ctx @ w("att_out.w") + w("att_out.b")
             h2 = _ln(x, w("ln2.scale"), w("ln2.bias"), eps)
             ff = jax.nn.gelu(h2 @ w("ffn1.w") + w("ffn1.b"),

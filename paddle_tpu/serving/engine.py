@@ -349,6 +349,11 @@ class ServingEngine:
         self._next_rid = 0
         self._prefill_fns = {}            # suffix bucket -> compiled fn
         self._decode_fn = None
+        # entry-point label -> the kernel-backend selections the kernel
+        # registry recorded while that executable traced (so operators
+        # can see WHICH attention spelling each compile used — paged
+        # kernel vs the PADDLE_TPU_PAGED_ATTN=0 gather fallback)
+        self.kernel_backends = {}
         self._thread = None
         self._stop = threading.Event()
         self._error = None                # fatal error: engine is dead
@@ -638,6 +643,7 @@ class ServingEngine:
         fixed shapes (bucketed prefill, the decode chunk), so the AOT
         executable serves all of them.  Backends without AOT fall back
         to the plain jit callable."""
+        from .. import kernels as _kernels
         from ..analysis.hlo_tools import compiled_memory_stats
 
         box = {}
@@ -650,12 +656,27 @@ class ServingEngine:
             # shed every arrival against a regime that no longer exists
             if box.get("c") is not None:
                 return
+            _kernels.reset_selected()
             try:
                 c = fn.lower(*args).compile()
             except Exception:
                 box["c"] = fn  # no AOT on this backend: plain jit
                 return
+            finally:
+                # which kernel spelling this executable traced with —
+                # per entry point, so operators can tell a paged-kernel
+                # compile from a PADDLE_TPU_PAGED_ATTN=0 gather compile
+                sel = _kernels.selected_backends()
+                if sel:
+                    self.kernel_backends[label] = sel
             box["c"] = c
+            if _bd._paged_attn_on() and "paged_attention" in sel:
+                self._reg.counter(
+                    "serving.paged_attn_compiles",
+                    help="serving executables compiled through the "
+                         "paged_attention kernel (vs the "
+                         "PADDLE_TPU_PAGED_ATTN=0 gather spelling)",
+                ).inc()
             stats = compiled_memory_stats(c)
             if stats:
                 self._reg.gauge(

@@ -45,22 +45,25 @@ from .costmodel import (
     model_status, reset_model)
 from .space import (
     POLICY_ORDER, WorkloadKey, attention_candidates,
-    estimate_gpt_step_hbm, prune_static, schedule_candidates,
-    serving_candidates, spec_candidates)
+    estimate_gpt_step_hbm, paged_attention_candidates, prune_static,
+    schedule_candidates, serving_candidates, spec_candidates)
 from .search import (
     PreflightRejected, flagship_dims, flagship_static_demo,
-    tune_gpt_step, tune_serving_decode, tune_spec_decode)
+    tune_gpt_step, tune_paged_attention, tune_serving_decode,
+    tune_spec_decode)
 
 __all__ = [
     "CACHE_SCHEMA_VERSION", "TuneCache", "cache_path",
     "geometry_fingerprint", "get_cache", "reset_cache",
     "POLICY_ORDER", "WorkloadKey", "attention_candidates",
-    "estimate_gpt_step_hbm", "prune_static", "schedule_candidates",
+    "estimate_gpt_step_hbm", "paged_attention_candidates",
+    "prune_static", "schedule_candidates",
     "serving_candidates", "spec_candidates", "PreflightRejected",
     "flagship_dims", "flagship_static_demo", "tune_gpt_step",
-    "tune_serving_decode", "tune_spec_decode",
+    "tune_paged_attention", "tune_serving_decode", "tune_spec_decode",
     "tune_mode", "attention_config", "schedule_config_for",
     "serving_decode_config", "spec_decode_config",
+    "paged_attention_config",
     "forced_attention_config", "tune_stats",
     "COSTMODEL_SCHEMA_VERSION", "CostModel", "costmodel_enabled",
     "costmodel_path", "fit_and_save", "fit_cost_model", "get_model",
@@ -158,6 +161,20 @@ def serving_decode_config(max_len, d_head, n_head, dtype):
     if max_len is None or int(max_len) <= 0:
         return None
     return _cache_lookup("serving_decode", max_len, d_head, n_head,
+                         dtype, remat="-")
+
+
+def paged_attention_config(seq_len, d_head, n_head, dtype):
+    """Hot-path lookup for ``serving.batched_decode``'s paged
+    attention: the tuned ``{"backend", "block_step"}`` for one slot KV
+    capacity (workload key ``op=paged_attention``, keyed on the logical
+    capacity ``T = NB * block_tokens`` like the other serving ops), or
+    None — the kernel keeps its defaults (auto backend, one table entry
+    per scan step).  Consulted at TRACE time, so a tuned entry costs
+    one lookup per compile, never per step."""
+    if seq_len is None or int(seq_len) <= 0:
+        return None
+    return _cache_lookup("paged_attention", seq_len, d_head, n_head,
                          dtype, remat="-")
 
 

@@ -232,6 +232,40 @@ def serving_candidates(max_len, chunks=(2, 4, 8, 16, 32),
     return out
 
 
+def paged_attention_candidates(num_table_blocks,
+                               backends=("xla_ref", "pallas_tpu",
+                                         "triton"),
+                               block_steps=(1, 2, 4, 8)):
+    """The ``op="paged_attention"`` candidate list: block-iteration
+    geometry x registry backend — ``{"backend", "block_step"}`` dicts
+    (docs/kernels.md, docs/autotune.md "Adding a tunable op").
+
+    ``block_step`` is how many table entries the ``xla_ref`` block scan
+    consumes per step (``[S, block_step*B, h, dh]`` in flight): larger
+    steps amortize per-iteration overhead against a bigger live tile —
+    measured, not derived.  The ``pallas_tpu`` and ``triton`` lowerings
+    fix their own iteration shape (one physical block per sequential
+    grid step / per ``fori_loop`` iteration), so like the geometry-free
+    backends in :func:`attention_candidates` each contributes ONE
+    candidate with ``block_step=None``.  The static prune is pure
+    arithmetic: a step beyond the chain length degenerates to the full
+    gather this op class exists to kill."""
+    out = []
+    nb = max(1, int(num_table_blocks))
+    for b in backends:
+        if b == "xla_ref":
+            seen = set()
+            for bs in block_steps:
+                bs = max(1, min(int(bs), nb))
+                if bs in seen:
+                    continue
+                seen.add(bs)
+                out.append({"backend": "xla_ref", "block_step": bs})
+        else:
+            out.append({"backend": str(b), "block_step": None})
+    return out
+
+
 def spec_candidates(max_len, ks=(1, 2, 3, 4, 6, 8)):
     """The ``op="spec_decode"`` candidate list: the speculative draft
     window ``k`` — ``{"k"}`` dicts (docs/autotune.md "Adding a tunable

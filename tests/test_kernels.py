@@ -9,6 +9,12 @@ registry's reason.  The GPU (triton) kernels additionally run
 interpret-forced so their logic is covered on CPU-only CI.  Within a
 backend the contract is bit-exact run-to-run.
 
+Paged-attention contract: every backend of the ``paged_attention`` op
+class matches an independent dense gather+masked-softmax spelling over
+ragged block chains (CoW fork, trash-padded tail, garbage trash block)
+for W=1 decode and W>1 verify windows; tokens past ``pos`` and the
+trash block are provably inert (corruption leaves output bit-equal).
+
 Registry contract: precedence explicit arg > per-op env > global env >
 auto; unknown backends raise ValueError; explicitly requested
 unavailable backends raise KernelUnavailable with a reason; a global
@@ -197,6 +203,120 @@ def test_decode_gather_bit_exact_across_backends():
         got = decode_gather(pool, table, interpret=True)
         assert bool(jnp.array_equal(ref, got))
         assert ref.shape == (3, 24, 2, 8)
+
+
+# -- paged attention oracle suite --------------------------------------------
+
+def _paged_case(dt, w=1, seed=11):
+    """Three ragged chains over a 10-block pool: a copy-on-write fork
+    (slot 2 shares slot 0's head block), a trash-padded tail (slot 1's
+    last table entry is block 0), and a garbage-filled trash block so
+    any masking bug surfaces as 1e3-scale output."""
+    rng = np.random.default_rng(seed)
+    S, NB, B, h, dh = 3, 3, 4, 2, 16
+    pool_k = jnp.asarray(
+        rng.normal(size=(1 + S * NB, B, h, dh)) * 0.5, dt)
+    pool_v = jnp.asarray(
+        rng.normal(size=(1 + S * NB, B, h, dh)) * 0.5, dt)
+    pool_k = pool_k.at[0].set(1e3)
+    pool_v = pool_v.at[0].set(1e3)
+    table = jnp.asarray(1 + np.arange(S * NB).reshape(S, NB), jnp.int32)
+    table = table.at[2, 0].set(table[0, 0])      # CoW fork
+    table = table.at[1, 2].set(0)                # trash tail
+    q = jnp.asarray(rng.normal(size=(S, w, h, dh)) * 0.5, dt)
+    # per-slot last-visible positions; slot 1 must stay short of its
+    # trash tail (chain tokens 8..11) for every window column
+    base = jnp.asarray([[7], [5], [9]], jnp.int32)
+    pos = base - (w - 1) + jnp.arange(w, dtype=jnp.int32)[None, :]
+    return q, pool_k, pool_v, table, pos
+
+
+def _paged_dense(q, pool_k, pool_v, table, pos):
+    """Independent spelling: the decode_gather oracle followed by one
+    dense masked softmax — exactly the materialization the paged op
+    class exists to kill."""
+    gather = get_kernel("decode_gather", "xla_ref").impl.call
+    kb = gather(pool_k, table)
+    vb = gather(pool_v, table)
+    s = jnp.einsum("swhd,sthd->swht", q, kb,
+                   preferred_element_type=jnp.float32)
+    s = s * (1.0 / float(np.sqrt(q.shape[-1])))
+    j = jnp.arange(kb.shape[1], dtype=jnp.int32)
+    s = jnp.where(j[None, None, None, :] <= pos[:, :, None, None],
+                  s, -1e30)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    l = jnp.sum(p, axis=-1)
+    ctx = jnp.einsum("swht,sthd->swhd", p, vb.astype(jnp.float32))
+    return (ctx / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(q.dtype)
+
+
+@pytest.mark.parametrize("backend", kernels.BACKENDS)
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("w", [1, 3])
+def test_paged_oracle_parity(backend, dtype, w):
+    """Every available backend matches the dense gather+softmax oracle
+    within ORACLE_TOL — single-token decode (W=1) and the speculative
+    verify window (W=3), CoW fork and trash masking included."""
+    impl = _impl_or_skip("paged_attention", backend)
+    q, pk, pv, tbl, pos = _paged_case(jnp.dtype(dtype), w=w)
+    got = impl.call(q, pk, pv, tbl, pos)
+    assert got.dtype == q.dtype and got.shape == q.shape
+    assert _rel_err(got, _paged_dense(q, pk, pv, tbl, pos)) <= oracle_tol(
+        "paged_attention", dtype, "fwd")
+
+
+@pytest.mark.parametrize("backend", ["pallas_tpu", "triton"])
+def test_paged_interpret_covers_kernel_logic(backend):
+    """The TPU grid and GPU fori_loop lowerings run interpret-forced so
+    their block-streaming logic is covered on CPU-only CI."""
+    impl = get_kernel("paged_attention", backend).impl
+    q, pk, pv, tbl, pos = _paged_case(jnp.float32, w=2)
+    assert _rel_err(
+        impl.call(q, pk, pv, tbl, pos, interpret=True),
+        _paged_dense(q, pk, pv, tbl, pos)) <= oracle_tol(
+            "paged_attention", "float32", "fwd")
+
+
+def test_paged_block_step_invariance():
+    """block_step is a pure schedule knob: every step width — including
+    the clamped-to-chain one-wide-step spelling that takes the no-scan
+    direct path — lands within the f32 oracle bound of the dense
+    reference."""
+    impl = get_kernel("paged_attention", "xla_ref").impl
+    q, pk, pv, tbl, pos = _paged_case(jnp.float32, w=2)
+    ref = _paged_dense(q, pk, pv, tbl, pos)
+    tol = oracle_tol("paged_attention", "float32", "fwd")
+    for bs in (None, 1, 2, 3, 99):
+        assert _rel_err(impl.call(q, pk, pv, tbl, pos, block_step=bs),
+                        ref) <= tol, bs
+
+
+def test_paged_bit_exact_run_to_run():
+    impl = get_kernel("paged_attention", "xla_ref").impl
+    q, pk, pv, tbl, pos = _paged_case(jnp.float32)
+    jf = jax.jit(lambda *a: impl.call(*a))
+    assert bool(jnp.array_equal(jf(q, pk, pv, tbl, pos),
+                                jf(q, pk, pv, tbl, pos)))
+
+
+def test_paged_masking_ignores_future_and_trash_content():
+    """Tokens past ``pos`` and the trash block never reach the output:
+    corrupting them leaves the result bit-identical.  This invariant is
+    what makes block-granular reservation and CoW forks safe — reserved
+    tail blocks hold stale garbage by design."""
+    impl = get_kernel("paged_attention", "xla_ref").impl
+    q, pk, pv, tbl, pos = _paged_case(jnp.float32, w=1)
+    base = impl.call(q, pk, pv, tbl, pos)
+    # slot 0 (pos 7): chain block 2 entirely unused; slot 1 (pos 5):
+    # tokens 6..7 of chain block 1 unused; slot 2 (pos 9): tokens
+    # 10..11 of chain block 2 unused; trash block 0 always masked
+    def corrupt(pool):
+        return (pool.at[tbl[0, 2]].set(7e4)
+                    .at[tbl[1, 1], 2:].set(7e4)
+                    .at[tbl[2, 2], 2:].set(7e4)
+                    .at[0].set(-9e4))
+    again = impl.call(q, corrupt(pk), corrupt(pv), tbl, pos)
+    assert bool(jnp.array_equal(base, again))
 
 
 @pytest.mark.parametrize("backend", ["pallas_tpu", "xla_ref"])
@@ -509,3 +629,54 @@ def test_truncate_survivors_keeps_every_backend():
     report2 = {}
     same = _truncate_survivors(list(survivors), 10, report2)
     assert len(same) == 6 and "truncated_to" not in report2
+
+
+def test_paged_attention_candidates_geometry():
+    from paddle_tpu.tune.space import paged_attention_candidates
+
+    cands = paged_attention_candidates(3)
+    xr = [c for c in cands if c["backend"] == "xla_ref"]
+    # the default steps clamp to the 3-block chain and dedupe:
+    # (1, 2, 4, 8) -> (1, 2, 3)
+    assert sorted(c["block_step"] for c in xr) == [1, 2, 3]
+    fixed = [c for c in cands if c["backend"] != "xla_ref"]
+    # the TPU/GPU lowerings fix their own iteration shape: one
+    # candidate each, no geometry cross
+    assert {c["backend"] for c in fixed} == {"pallas_tpu", "triton"}
+    assert all(c["block_step"] is None for c in fixed)
+
+
+def test_tune_paged_attention_search_and_hot_path_lookup(tmp_path,
+                                                        monkeypatch):
+    """op=paged_attention end to end: a search measures xla_ref
+    block-step candidates on a synthetic ragged pool, persists the
+    winner, and ``tune.paged_attention_config`` (the lookup
+    ``serving.batched_decode`` consults at trace time) serves it from a
+    fresh cache read."""
+    from paddle_tpu import tune
+    from paddle_tpu.tune import reset_cache
+    from paddle_tpu.tune.search import tune_paged_attention
+
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuned.json"))
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "search")
+    reset_cache()
+    try:
+        rep = tune_paged_attention(
+            n_head=2, d_head=16, max_len=16, block_tokens=4, slots=2,
+            block_steps=(1, 2), backends=("xla_ref",), max_measure=4,
+            repeats=1, force=True, mode="search")
+        assert rep["source"] == "search", rep
+        measured = [m for m in rep["measured"]
+                    if m.get("verdict") == "measured"]
+        assert len(measured) == 2
+        cfg = rep["entry"]["config"]
+        assert cfg["backend"] == "xla_ref"
+        assert cfg["block_step"] in (1, 2)
+        reset_cache()   # force a disk read: the entry persisted
+        got = tune.paged_attention_config(16, 16, 2, "float32")
+        assert got == cfg
+        # cached mode on a MISS never compiles (and never invents)
+        assert tune.paged_attention_config(999, 16, 2, "float32") is None
+    finally:
+        reset_cache()

@@ -2,7 +2,11 @@
 pool refcount lifecycle, prefix-trie match/insert/copy-on-write fork,
 LRU eviction under capacity pressure, and the engine-level bit-exact
 served-vs-single-stream identity parameterized over prefix reuse on/off
-and f32/bf16.  All on the CPU backend (conftest), tiny model shapes."""
+and f32/bf16.  The slow tail additionally proves the
+``PADDLE_TPU_PAGED_ATTN`` kill switch: the paged_attention kernel and
+the decode_gather + dense-softmax spelling serve bit-identical tokens,
+including through the speculative verify window.  All on the CPU
+backend (conftest), tiny model shapes."""
 
 import numpy as np
 import pytest
@@ -245,6 +249,84 @@ def test_served_equals_single_stream_with_prefix_traffic(dtype, reuse):
     else:
         assert st.get("serving.prefix_hit_rate", 0.0) == 0.0
         assert eng.prefix_trie is None
+
+
+# -- engine-level: paged-attention kill switch -------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("reuse", [True, False])
+def test_paged_kill_switch_engine_bit_exact(monkeypatch, reuse):
+    """PADDLE_TPU_PAGED_ATTN=0 (the decode_gather + dense-softmax
+    oracle spelling) and =1 (the paged_attention kernel) serve
+    bit-identical tokens, both equal to single-stream generate —
+    prefix reuse on and off, CoW-fork traffic included.  The env var is
+    read at trace time, so each setting gets a fresh engine; the
+    kernel-backend recording proves which spelling actually compiled."""
+    params = _make_params()
+    rng = np.random.default_rng(21)
+    base = rng.integers(1, VOCAB, (11,)).astype(np.int32)
+    prompts = [
+        base.copy(),
+        np.concatenate([base[:6],                      # CoW fork at 6
+                        rng.integers(1, VOCAB, (4,)).astype(np.int32)]),
+        rng.integers(1, VOCAB, (8,)).astype(np.int32),
+    ]
+
+    def serve(env):
+        _obs.get_registry().clear(prefix="serving.")
+        monkeypatch.setenv("PADDLE_TPU_PAGED_ATTN", env)
+        eng = ServingEngine(params, NL, NH, DM, max_len=T, max_slots=3,
+                            decode_chunk=4, min_bucket=4, block_tokens=4,
+                            prefix_reuse=reuse)
+        return eng.generate_many(prompts, max_new_tokens=7), eng
+
+    paged_outs, paged_eng = serve("1")
+    assert any("paged_attention" in sel
+               for sel in paged_eng.kernel_backends.values())
+    assert paged_eng.stats()["serving.paged_attn_compiles"] >= 1
+    gather_outs, gather_eng = serve("0")
+    assert all("paged_attention" not in sel
+               for sel in gather_eng.kernel_backends.values())
+    assert "serving.paged_attn_compiles" not in gather_eng.stats()
+    for p, a, b in zip(prompts, paged_outs, gather_outs):
+        np.testing.assert_array_equal(a, b)
+        ref, _ = transformer.generate(params, p[None], max_len=T,
+                                      n_layer=NL, n_head=NH, d_model=DM,
+                                      return_logits=False)
+        np.testing.assert_array_equal(a, np.asarray(ref)[0][: len(p) + 7])
+
+
+@pytest.mark.slow
+def test_spec_parity_through_paged_verify_window(monkeypatch):
+    """Speculative decoding scores its draft windows through the paged
+    kernel (W = k+1 is the multi-token shape): committed tokens are
+    identical to the PADDLE_TPU_PAGED_ATTN=0 spec engine and to plain
+    greedy decode, with speculative rounds actually run."""
+    from paddle_tpu.serving import speculative as spec
+
+    params = _make_params()
+    rng = np.random.default_rng(22)
+    prompts = [rng.integers(1, VOCAB, (l,)).astype(np.int32)
+               for l in (5, 9, 7)]
+
+    def serve(env):
+        _obs.get_registry().clear(prefix="serving.")
+        monkeypatch.setenv("PADDLE_TPU_PAGED_ATTN", env)
+        eng = ServingEngine(params, NL, NH, DM, max_len=T, max_slots=3,
+                            decode_chunk=4, min_bucket=4, block_tokens=4,
+                            draft_params=spec.depth_draft(params, 1),
+                            spec_k=3)
+        outs = eng.generate_many(prompts, max_new_tokens=8)
+        assert eng._spec.proposed > 0
+        return outs
+
+    paged, gather = serve("1"), serve("0")
+    for p, a, b in zip(prompts, paged, gather):
+        np.testing.assert_array_equal(a, b)
+        ref, _ = transformer.generate(params, p[None], max_len=T,
+                                      n_layer=NL, n_head=NH, d_model=DM,
+                                      return_logits=False)
+        np.testing.assert_array_equal(a, np.asarray(ref)[0][: len(p) + 8])
 
 
 def test_engine_pool_accounting_no_leak():
