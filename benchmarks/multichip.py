@@ -25,7 +25,14 @@ are deterministic on the virtual CPU mesh:
   per-layer weights shard at rest (``param_bytes_per_device`` <=
   replicated / (fsdp_degree/2)), the weight all-gathers sit INSIDE the
   scan-remat loop, and reduce-class collectives stay out of loop bodies
-  (one gradient reduction per optimizer step, docs/parallel.md "FSDP").
+  (one gradient reduction per optimizer step, docs/parallel.md "FSDP");
+* ``gate_zero3_grad_rs``    — under the default PADDLE_TPU_ZERO3_RS
+  spelling ``grad_bytes_per_device`` sits STRICTLY below the replicated
+  figure (and <= replicated / (fsdp_degree/2)) with a non-empty
+  boundary reduce class — the true-ZeRO-3 reduce-scatter win
+  (docs/parallel.md rule 4).  ``boundary_comm_bytes`` /
+  ``grad_bytes_per_device`` ship in the row for bench-history
+  trajectory tracking.
 
 Step times on the virtual CPU mesh share host cores and are indicative
 only; the gates are the contract.
@@ -225,6 +232,19 @@ def _train_gpt(cfg, mesh, n_chips, accum, steps, warmup, tp_rules=False,
                 srep["params"]["total_bytes"])
             facts["param_bytes_per_device"] = (
                 srep["params"]["per_device_bytes"])
+            # true-ZeRO-3 comm facts (docs/parallel.md rule 4): each
+            # chip receives only its grad shard, so grads/device drop
+            # with fsdp_degree and the boundary reduce class runs at
+            # shard volume instead of full parameter volume
+            facts["grad_bytes_replicated"] = (
+                srep["grads"]["total_bytes"])
+            facts["grad_bytes_per_device"] = (
+                srep["grads"]["per_device_bytes"])
+            plan = getattr(exe, "last_comm_plan", None)
+            if plan is not None:
+                facts["boundary_comm_bytes"] = sum(
+                    op.bytes for op in plan.select(kind="reduce",
+                                                   in_loop=False))
             rep = srep["opt_state"]
             facts["opt_state_bytes_replicated"] = rep["total_bytes"]
             facts["opt_state_bytes_per_device"] = rep["per_device_bytes"]
@@ -330,6 +350,9 @@ def run(row, devices=8, smoke=True, steps=None, warmup=None, accum=4,
         row.update({k: v for k, v in fn_.items()
                     if k not in ("cost", "param_bytes_per_device",
                                  "param_bytes_replicated",
+                                 "grad_bytes_per_device",
+                                 "grad_bytes_replicated",
+                                 "boundary_comm_bytes",
                                  "remat_plan")})
         row["dp_cost"] = fn_["cost"]
 
@@ -378,6 +401,11 @@ def run(row, devices=8, smoke=True, steps=None, warmup=None, accum=4,
                 - (ffs.get("reduce_ops_in_loop") or 0))
             row["fsdp_groups"] = sum(
                 1 for g in ffs.get("remat_plan", ()) if g.get("fsdp"))
+            row["grad_bytes_per_device"] = ffs.get(
+                "grad_bytes_per_device")
+            row["grad_bytes_replicated"] = ffs.get(
+                "grad_bytes_replicated")
+            row["boundary_comm_bytes"] = ffs.get("boundary_comm_bytes")
 
             def _gate_fsdp():
                 per = row.get("param_bytes_per_device")
@@ -392,7 +420,17 @@ def run(row, devices=8, smoke=True, steps=None, warmup=None, accum=4,
                     plan = ffs.get("accum_plan") or {}
                     assert plan.get("mode") == "local", plan
 
+            def _gate_grad_rs():
+                # true ZeRO-3: reduce-scatter at the boundary means
+                # grads/device sit STRICTLY below the replicated figure
+                per = row.get("grad_bytes_per_device")
+                total = row.get("grad_bytes_replicated")
+                assert per and total and per < total, (per, total)
+                assert per * (fsdp_deg // 2) <= total, (per, total)
+                assert (row.get("boundary_comm_bytes") or 0) > 0, row
+
             gate("fsdp_param_sharding", _gate_fsdp)
+            gate("zero3_grad_rs", _gate_grad_rs)
 
         if not smoke and n % 2 == 0:
             log(f"transformer dp={n // 2} x tp=2 ...")

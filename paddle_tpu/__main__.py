@@ -437,10 +437,23 @@ def cmd_multichip_selftest(args=None):
     from paddle_tpu.parallel.mesh import make_mesh
 
     failures = []
+    import time as _time
+
+    gate_t0 = [_time.monotonic()]
+    gate_times = []
 
     def check(cond, what):
+        # per-gate wall time: everything since the previous gate (the
+        # training/compile work this gate consumed — the first gate of
+        # each shared-executable family carries its compiles) is
+        # charged to it, so a regression in gate cost is visible in
+        # the selftest output (the runtime-audit discipline)
+        now = _time.monotonic()
+        gate_times.append((what, now - gate_t0[0]))
+        gate_t0[0] = now
         (failures.append(what) if not cond else None)
-        print(("ok   " if cond else "FAIL ") + what)
+        print(("ok   " if cond else "FAIL ") + what
+              + f"  [{gate_times[-1][1]:.1f}s]")
 
     cfg = dict(vocab_size=256, n_layer=2, n_head=2, d_model=64,
                max_len=32, dropout_rate=0.0, dtype="float32",
@@ -531,8 +544,9 @@ def cmd_multichip_selftest(args=None):
     mesh_f = make_mesh({"dp": n // 4, "fsdp": 4})
     cfg_f = dict(cfg, n_layer=3)
 
-    def train_fsdp(fsdp):
+    def train_fsdp(fsdp, rs="1"):
         os.environ["PADDLE_TPU_FSDP"] = fsdp
+        os.environ["PADDLE_TPU_ZERO3_RS"] = rs
         try:
             pt.core.unique_name.reset()
             main_prog, startup = pt.Program(), pt.Program()
@@ -564,14 +578,15 @@ def cmd_multichip_selftest(args=None):
                         papi.sharding_report(main_prog, mesh_f),
                         str(getattr(scope.get(tagged[0]), "sharding",
                                     None)),
-                        exe.last_comm_plan)
+                        exe.last_comm_plan, tagged)
             finally:
                 pt.core.scope._scope_stack.pop()
         finally:
             os.environ.pop("PADDLE_TPU_FSDP", None)
+            os.environ.pop("PADDLE_TPU_ZERO3_RS", None)
 
     (losses_f, grads_f, params_f, cost_f, plan_f, remat_f, rep_f,
-     wsh_f, comm_plan_f) = train_fsdp("1")
+     wsh_f, comm_plan_f, tagged_f) = train_fsdp("1")
     scanned = [g for g in remat_f if g.get("fsdp")]
     check(bool(scanned) and scanned[0]["fsdp"] > 0,
           f"scan-remat group runs with fsdp-sharded stacked weights "
@@ -598,14 +613,89 @@ def cmd_multichip_selftest(args=None):
           f"fsdp weight gathers, zero in-loop reduces, boundary "
           f"reduce present (violations: "
           f"{[v['message'] for v in viol_f] or 'none'})")
+    # ---- true ZeRO-3 gradient path (docs/parallel.md rule 4): the
+    # rs=0 executable set below is compiled ONCE and shared by the
+    # kill-switch, bit-exactness, reduce-set and comm_diff gates — the
+    # rs=1 set above already served the sharding/contract/bytes gates
+    # (the runtime-audit discipline: one compile per distinct config).
+    from paddle_tpu.analysis.comm import comm_diff
+    from paddle_tpu.parallel.contracts import zero3_grad_contract
+
+    # (1) exactly one reduce-scatter@fsdp per fsdp-tagged grad at the
+    # optimizer boundary, zero in-loop reduce-class collectives —
+    # evaluated as a CommContract over the compiled step's CommPlan
+    viol_rs = zero3_grad_contract(
+        mesh_f, n_grads=len(tagged_f)).check(comm_plan_f)
+    rs_ops = comm_plan_f.select(kind="reduce-scatter", axis="fsdp",
+                                in_loop=False)
+    rs_sites = {(op.provenance or {}).get("site", "").split(":", 1)[-1]
+                for op in rs_ops}
+    check(not viol_rs and rs_sites == set(tagged_f),
+          f"zero3_grad_contract holds: {len(rs_ops)} boundary "
+          f"reduce-scatter@fsdp, one per fsdp-tagged grad "
+          f"({len(tagged_f)} tagged; violations: "
+          f"{[v['message'] for v in viol_rs] or 'none'})")
+    # (2) the prologue/epilogue is truly sharded: embedding table +
+    # LM head param AND opt-state bytes/device at most
+    # replicated/(fsdp_degree/2)
+    prologue = [nm for nm in ("tok_emb.w", "pos_emb.w.w", "lm_head.w")
+                if nm in rep_f["params"]["vars"]]
+    pvars = rep_f["params"]["vars"]
+    ovars = rep_f["opt_state"]["vars"]
+    pro_total = (sum(pvars[nm]["bytes"] for nm in prologue)
+                 + sum(v["bytes"] for nm in prologue
+                       for o, v in ovars.items() if nm in o))
+    pro_dev = (sum(pvars[nm]["per_device_bytes"] for nm in prologue)
+               + sum(v["per_device_bytes"] for nm in prologue
+                     for o, v in ovars.items() if nm in o))
+    check(len(prologue) == 3 and pro_dev * 2 <= pro_total,
+          f"embedding + LM head param/opt-state bytes/device {pro_dev} "
+          f"<= replicated {pro_total} / (fsdp_degree/2)")
+    (losses_r0, grads_r0, params_r0, cost_r0, _plan_r0, _remat_r0,
+     rep_r0, _wsh_r0, comm_plan_r0, _tagged_r0) = train_fsdp("1",
+                                                             rs="0")
+    # (3) 5-step loss+grads+params bit-exact vs the replicated-grad
+    # spelling (PADDLE_TPU_ZERO3_RS=0 restores it exactly)
+    check(not comm_plan_r0.select(kind="reduce-scatter")
+          and rep_r0["grads"]["per_device_bytes"]
+          == rep_r0["grads"]["total_bytes"],
+          "PADDLE_TPU_ZERO3_RS=0 restores the replicated-grad "
+          "spelling (no reduce-scatter, grads replicated)")
+    check(all(np.array_equal(a, b)
+              for a, b in zip(losses_f, losses_r0)),
+          "ZeRO-3 RS loss bit-exact vs replicated-grad spelling "
+          "(5 steps)")
+    check(all(np.array_equal(a, b)
+              for ga, gb in zip(grads_f, grads_r0)
+              for a, b in zip(ga, gb)),
+          "ZeRO-3 RS grads bit-exact vs replicated-grad spelling "
+          "(5 steps)")
+    check(all(np.array_equal(params_f[k], params_r0[k])
+              for k in params_f),
+          "ZeRO-3 RS updated params bit-exact vs replicated-grad "
+          "spelling")
+    # (4) comm_diff explains the move: the full-volume boundary
+    # all-reduce@dp bucket shrinks, reduce-scatter@fsdp appears
+    d = comm_diff(comm_plan_r0, comm_plan_f, name_a="replicated",
+                  name_b="zero3-rs")
+    moved = {c["kind"] for c in d["changed"]}
+    ar_dp = [c for c in d["changed"]
+             if c["kind"] == "all-reduce" and c["axes"] == "dp"
+             and c["phase"] == "boundary"]
+    check("reduce-scatter" in moved and ar_dp
+          and ar_dp[0]["bytes_b"] < ar_dp[0]["bytes_a"],
+          "comm_diff names the moved collectives (reduce-scatter "
+          "appears, boundary all-reduce@dp bytes shrink): "
+          + "; ".join(d["text"][:4]))
     (losses_f0, grads_f0, params_f0, cost_f0, _plan_f0, _remat_f0,
-     rep_f0, _wsh_f0, _cp_f0) = train_fsdp("0")
+     rep_f0, _wsh_f0, _cp_f0, _tagged_f0) = train_fsdp("0")
     check(rep_f0["params"]["per_device_bytes"]
           == rep_f0["params"]["total_bytes"],
           "PADDLE_TPU_FSDP=0 replicates every parameter")
-    check(cost_f.get("reduce_ops") == cost_f0.get("reduce_ops"),
-          f"boundary reduce set unchanged by fsdp "
-          f"({cost_f.get('reduce_ops')} == {cost_f0.get('reduce_ops')} "
+    check(cost_r0.get("reduce_ops") == cost_f0.get("reduce_ops"),
+          f"boundary reduce set unchanged by fsdp under the "
+          f"replicated-grad spelling "
+          f"({cost_r0.get('reduce_ops')} == {cost_f0.get('reduce_ops')} "
           f"— one gradient reduction per optimizer step)")
     check(all(np.array_equal(a, b)
               for a, b in zip(losses_f, losses_f0)),
@@ -618,6 +708,10 @@ def cmd_multichip_selftest(args=None):
               for k in params_f),
           "FSDP updated params bit-exact vs replicated spelling")
 
+    slow = sorted(gate_times, key=lambda t: -t[1])[:3]
+    print("gate wall times: total "
+          + f"{sum(t for _, t in gate_times):.1f}s; slowest: "
+          + ", ".join(f"{w[:48]}={t:.1f}s" for w, t in slow))
     print("multichip selftest " + ("FAILED" if failures else "PASSED"))
     return 1 if failures else 0
 

@@ -434,11 +434,35 @@ def extract_comm_plan(text, mesh=None, label=None):
             if axis_groups:
                 axes = _axes_for_groups(groups, axis_groups, n_devices)
         in_loop = cur in loop_comps
+        provenance = _provenance(op_name)
+        # Reduce-scatter canonicalization (docs/parallel.md rule 4).
+        # A boundary all-reduce carrying ``pt_pin[grad_rs_boundary:*]``
+        # provenance is the Executor's ZeRO-3 gradient aggregation: its
+        # operand is the fsdp-SHARD of the gradient (GSPMD pushes the
+        # boundary pin's partition-id slice ahead of the reduce —
+        # slice-before-reduce is valid because dW is fsdp-replicated),
+        # so the op the chips actually run is a shard-volume
+        # all-reduce over the remaining reduce axes.  Logically over
+        # the full mesh that IS a reduce-scatter — reduce over dp,
+        # scatter over fsdp — and XLA pipelines with a
+        # ReduceScatterCreator pass (GPU/TPU) spell it as the literal
+        # instruction; the CPU pipeline never runs that pass, so the
+        # plan canonicalizes the provenance-marked form instead of
+        # reporting the spelling accident.  Bytes stay the op's true
+        # (shard) volume — the comm-contract and bench gates read the
+        # honest figure.
+        if (kind == "all-reduce" and not in_loop and provenance
+                and str(provenance.get("site", "")).startswith(
+                    "grad_rs_boundary:")
+                and mesh_axes.get("fsdp", 0) > 1
+                and "fsdp" not in (axes or ())):
+            kind = "reduce-scatter"
+            axes = tuple(axes or ()) + ("fsdp",)
         ops.append(CommOp(
             kind, nbytes, axes, in_loop,
             _classify_phase(in_loop, op_name, phase_label),
             computation=cur or "", op_name=op_name,
-            provenance=_provenance(op_name),
+            provenance=provenance,
             channel=int(chan_m.group(1)) if chan_m else None))
     return CommPlan(ops, mesh_axes, label)
 

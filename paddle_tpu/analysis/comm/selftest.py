@@ -20,7 +20,14 @@ On the 8-device virtual CPU mesh (dp=2 x fsdp=4):
      resulting gather/reduce traffic to the variable via its
      ``pt_shard[var]`` provenance, ``hlo.accidental-reshard`` warns,
      and a ``CommContract.forbid_reshard`` upgrades it to an
-     ``hlo.comm-contract`` error naming the var.
+     ``hlo.comm-contract`` error naming the var;
+
+  4. an IN-LOOP reduce-scatter (the ZeRO-3 gradient scatter mis-spelled
+     onto the accumulation carry, scattering every microbatch's partial
+     gradient inside the scan) — ``zero3_grad_contract``'s in-loop
+     forbid fires on the compiled plan with the offending ops
+     attributed as in-loop reduce traffic over ``fsdp``, while the
+     SAME contract holds on the clean spelling's plan.
 
 * **Plan fundamentals** — mesh-axis recovery from replica groups
   (in-loop ``all-gather@fsdp`` weight gathers, boundary reduce over
@@ -230,10 +237,19 @@ def run_selftest():
           f"forward scan ({len(gathers)} ops)")
     boundary = plan_on.select(kind="reduce", in_loop=False,
                               phase="boundary")
-    check(bool(boundary) and all("dp" in (o.axes or ())
-                                 for o in boundary),
-          f"boundary gradient reduction recovered over dp "
-          f"({len(boundary)} reduce ops)")
+    # under rule 4 the boundary reduce set is: the per-grad
+    # reduce-scatters (reduce over dp, scatter over fsdp), the
+    # untagged grads' all-reduce@dp, and the scalar grad-norm partial
+    # all-reduce@fsdp each scattered grad contributes — every op
+    # attributed, nothing outside the gradient axes
+    check(bool(boundary)
+          and any("dp" in (o.axes or ()) for o in boundary)
+          and all((o.axes or ())
+                  and set(o.axes) <= {"dp", "fsdp"} for o in boundary),
+          f"boundary gradient reduction recovered over the gradient "
+          f"axes ({len(boundary)} reduce ops, "
+          f"{len(plan_on.select(kind='reduce-scatter'))} canonicalized "
+          f"reduce-scatters)")
     check(not plan_on.unattributed(),
           "every collective's replica groups match a mesh-axis subset")
     diff = comm_diff(plan_off, plan_on, "FSDP=0", "FSDP=1")
@@ -244,6 +260,36 @@ def run_selftest():
           f"comm_diff explains the moved op: FSDP adds the in-loop "
           f"fsdp gathers ({diff['text'][:2]})")
 
+    # ---- planted violation 4: the IN-LOOP reduce-scatter --------------
+    from paddle_tpu.parallel.contracts import zero3_grad_contract
+
+    check(not zero3_grad_contract(mesh).check(plan_on),
+          "clean FSDP spelling: zero3_grad_contract holds (boundary "
+          "reduce-scatter@fsdp, zero in-loop reduces)")
+    # the mis-spelling: the ZeRO-3 scatter composed onto the accum
+    # carry — every microbatch's partial gradient reduce-scattered
+    # INSIDE the scan, the per-iteration traffic rule 4 exists to
+    # forbid.  (The jaxpr check catches the stray carry SITE above;
+    # this proves the comm layer catches the resulting TRAFFIC
+    # independently, for spellings no blessed-site audit sees.)
+    ex._accum_carry_spec = composed_carry_spec
+    try:
+        plan_bad = compile_plan("1")
+    finally:
+        ex._accum_carry_spec = orig_spec
+    viol = zero3_grad_contract(mesh).check(plan_bad)
+    bad_rs = [v for v in viol if v["rule"]["rule"] == "forbid"
+              and v["op_count"] > 0]
+    check(bool(bad_rs),
+          f"planted in-loop scatter (fsdp-composed carry): "
+          f"zero3_grad_contract forbids the in-loop reduce traffic "
+          f"({bad_rs[0]['op_count'] if bad_rs else 0} ops, "
+          f"{bad_rs[0]['bytes'] if bad_rs else 0}B)")
+    check(bool(bad_rs) and all("fsdp" in o and "in-loop" in o
+                               for o in bad_rs[0]["ops"]),
+          f"violation attributed to in-loop reduce@fsdp "
+          f"({bad_rs[0]['ops'][:2] if bad_rs else []})")
+
     # ---- the clean sweep: policies x FSDP x ZeRO ----------------------
     for policy in POLICIES:
         for fsdp in ("1", "0"):
@@ -253,7 +299,8 @@ def run_selftest():
                 try:
                     main, _startup, outs = build(policy=policy)
                     for c in training_step_contract(
-                            mesh, accum=True, fsdp=fsdp == "1"):
+                            mesh, accum=True, fsdp=fsdp == "1",
+                            grad_rs=fsdp == "1"):
                         attach_comm_contract(main, c)
                     rep = analysis.lint(
                         main, feed=feed,

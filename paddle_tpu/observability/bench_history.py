@@ -86,7 +86,8 @@ _LOWER_IS_BETTER = {
         "cost-model error: lower is better; tracked as |err| so the "
         "fitted model's drift vs best-so-far gates in CI",
 }
-_MULTICHIP_METRICS = ("scaling_efficiency", "param_bytes_per_device")
+_MULTICHIP_METRICS = ("scaling_efficiency", "param_bytes_per_device",
+                      "grad_bytes_per_device", "boundary_comm_bytes")
 _SERVING_METRICS = ("tok_s", "speedup", "goodput_under_slo",
                     "prefix_hit_rate", "spec_goodput_under_slo",
                     "spec_accept_rate", "spec_speedup")
@@ -122,6 +123,16 @@ _REGRESSION_EXEMPT = {
     "param_bytes_per_device":
         "lower-is-better bytes figure on the virtual CPU mesh; the "
         "multichip gate_fsdp_param_sharding bound is the contract",
+    # ZeRO-3 reduce-scatter comm figures, same discipline: both track
+    # the toy smoke model's size on the virtual CPU mesh and LOWER is
+    # better — gate_zero3_grad_rs's strict per < replicated bound and
+    # zero3_grad_contract are the contracts (benchmarks/multichip.py)
+    "grad_bytes_per_device":
+        "lower-is-better bytes figure on the virtual CPU mesh; the "
+        "multichip gate_zero3_grad_rs bound is the contract",
+    "boundary_comm_bytes":
+        "lower-is-better bytes figure on the virtual CPU mesh; "
+        "zero3_grad_contract + gate_zero3_grad_rs are the contract",
 }
 
 # the t=16k rot class and its resolution evidence: a FAILED artifact

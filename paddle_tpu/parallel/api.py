@@ -41,9 +41,9 @@ from .mesh import axis_size
 
 __all__ = ["compile_shardings", "data_parallel", "shard_parameter",
            "shard_activation", "replicate", "P", "zero_spec_for",
-           "fsdp_spec_for", "shard_fsdp", "optimizer_state_report",
-           "sharding_report", "comm_overlap_flags",
-           "enable_comm_overlap"]
+           "fsdp_spec_for", "grad_rs_spec_for", "shard_fsdp",
+           "optimizer_state_report", "sharding_report",
+           "comm_overlap_flags", "enable_comm_overlap"]
 
 
 def _zero_enabled():
@@ -59,6 +59,18 @@ def _fsdp_enabled():
     means every parameter keeps its explicit (tp) spec or replicates —
     the bit-exactness reference spelling, exactly like PADDLE_TPU_ZERO."""
     return os.environ.get("PADDLE_TPU_FSDP", "1").lower() not in (
+        "0", "", "false")
+
+
+def _zero3_rs_enabled():
+    """ZeRO-3 reduce-scatter gradient kill switch
+    (``PADDLE_TPU_ZERO3_RS=0``): off restores the replicated-gradient
+    boundary spelling (every fsdp-tagged gradient pinned to its
+    parameter's EXPLICIT spec, cross-chip all-reduced at full volume,
+    sliced shard-locally by the update math) — the bit-exactness
+    reference spelling, exactly like PADDLE_TPU_ZERO /
+    PADDLE_TPU_FSDP."""
+    return os.environ.get("PADDLE_TPU_ZERO3_RS", "1").lower() not in (
         "0", "", "false")
 
 
@@ -107,6 +119,14 @@ def fsdp_spec_for(var, mesh, block=None):
     * indivisible shapes fall back to the inherited spec (None here —
       callers then use ``partition_spec`` as before) with the reason
       recorded via ``_record_shard_fallback``;
+    * a var tagged with ``fsdp_axes`` (the ``shard_fsdp`` prologue/
+      epilogue tagging: embeddings and the LM head) composes EVERY
+      listed free mesh axis onto the leading dim — the SpecLayout
+      ``P(('fsdp', 'tp'), None)`` spelling, so the two largest single
+      tensors shard over the full fsdp x tp extent and gather ONCE per
+      step outside the scan.  When the full composition does not
+      divide, the plain ``fsdp`` shard is retried before falling back
+      to replication;
     * kill switches: ``PADDLE_TPU_FSDP=0`` and the program-level
       ``program._fsdp = False`` (the autotuner's replicate schedule,
       ``memory_optimize(policy="auto")``) both resolve every candidate
@@ -145,18 +165,32 @@ def fsdp_spec_for(var, mesh, block=None):
             block, var, "fsdp", "leading axis already sharded over dp")
         return None
     mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    used = _spec_axes(base)
+    # the composed-axes tagging (fsdp_axes, e.g. ("fsdp", "tp") for the
+    # shard_fsdp-tagged embedding/LM head): every listed axis that
+    # exists on the mesh with size > 1 and is FREE in the explicit spec
+    # joins the leading-dim shard, largest composition first
+    want = tuple(getattr(var, "fsdp_axes", None) or ("fsdp",))
+    extra = tuple(a for a in want
+                  if a != "fsdp" and mesh_sizes.get(a, 0) > 1
+                  and a not in used and a not in cur)
+    dim = abs(int(shape[0])) if shape[0] else 0
+    for add in ((("fsdp",) + extra) if extra else (("fsdp",)),
+                ("fsdp",)):
+        denom = 1
+        for a in (*cur, *add):
+            denom *= mesh_sizes.get(a, 1)
+        if dim and dim % denom == 0:
+            base[0] = (*cur, *add) if (cur or len(add) > 1) else add[0]
+            return P(*base)
     denom = nf
     for a in cur:
         denom *= mesh_sizes.get(a, 1)
-    dim = abs(int(shape[0])) if shape[0] else 0
-    if not dim or dim % denom:
-        _record_shard_fallback(
-            block, var, "fsdp",
-            f"leading dim {shape[0]} not divisible by "
-            f"{'x'.join([*cur, 'fsdp'])}={denom}")
-        return None
-    base[0] = (*cur, "fsdp") if cur else "fsdp"
-    return P(*base)
+    _record_shard_fallback(
+        block, var, "fsdp",
+        f"leading dim {shape[0]} not divisible by "
+        f"{'x'.join([*cur, 'fsdp'])}={denom}")
+    return None
 
 
 def zero_spec_for(var, mesh, block=None):
@@ -218,6 +252,45 @@ def zero_spec_for(var, mesh, block=None):
     if all(e is None for e in base):
         return None
     return P(*base)
+
+
+def grad_rs_spec_for(var, mesh, block=None):
+    """The reduce-scatter boundary spec for one parameter's GRADIENT,
+    or None (docs/parallel.md rule 4 — "reduce-scatter at the boundary,
+    never in-loop").
+
+    The true-ZeRO-3 gradient spelling: an fsdp-tagged parameter's
+    gradient is pinned to the parameter's fsdp-COMPOSED spec at the
+    optimizer boundary (the Executor's ``pt_pin[grad_rs_boundary]``
+    site), so GSPMD spells the cross-chip aggregation as a
+    reduce-scatter@fsdp — each chip receives only its shard — instead
+    of a full-volume all-reduce followed by a local slice.  Resolves to
+    None (the replicated-grad reference spelling) when:
+
+    * ``PADDLE_TPU_ZERO3_RS=0`` (the kill switch — bit-exactness
+      reference), or
+    * the mesh has no dp axis of size > 1: a REDUCE-scatter needs a
+      reduce, and the boundary reduce is the dp gradient aggregation —
+      on an fsdp-only mesh every chip computes the full gradient
+      (replicated-compute ZeRO-3) and there is nothing to scatter; a
+      bare scatter constraint would only push partial-compute
+      reassociation into the backward and break the bit-exactness
+      contract (measured: ulp drift under ``reduce_each`` accumulation,
+      exact under the dp-sharded local carry), or
+    * the parameter is not fsdp-tagged / the mesh has no fsdp axis /
+      the shape fell back (``fsdp_spec_for`` returns None — the
+      gradient then rides the explicit-spec boundary pin exactly as
+      before).
+
+    The accumulation carry stays plain ``P('dp')`` and the scatter
+    happens ONCE at the boundary — the three PR-10 placement rules
+    survive unchanged; ``zero3_grad_contract``
+    (``parallel/contracts.py``) enforces the resulting comm shape."""
+    if var is None or mesh is None or not _zero3_rs_enabled():
+        return None
+    if axis_size(mesh, "dp") <= 1:
+        return None
+    return fsdp_spec_for(var, mesh, block)
 
 
 def _spec_for(var, mesh, block=None):
@@ -324,10 +397,22 @@ def shard_fsdp(program, programs=()):
     at-rest sharding (GSPMD places the gathers in the unrolled code).
     In either case every external input that maps to a DIFFERENT
     Parameter per period is a per-layer weight.  Shared inputs
-    (constants used identically every layer), carried activations and
-    non-repeated parameters (embeddings, the LM head) are left
-    untouched: they are consumed outside the scan body, and sharding
-    them would move their gathers outside the loop.
+    (constants used identically every layer) and carried activations
+    are left untouched.
+
+    The non-repeated PROLOGUE/EPILOGUE matrices — the embedding tables
+    and the LM head, the two largest single tensors in the model — are
+    additionally tagged with ``fsdp_axes=('fsdp', 'tp')``:
+    ``fsdp_spec_for`` composes every free listed axis onto their
+    leading dim (the SpecLayout ``P(('fsdp', 'tp'), None)`` spelling),
+    so they rest sharded over the full fsdp x tp extent, their moments
+    inherit the composed spec through ``zero_spec_for``, and their
+    gathers live OUTSIDE the scan — one gather per step, overlappable
+    via PADDLE_TPU_COMM_OVERLAP.  Only 2-D Parameters consumed outside
+    every scan group qualify; indivisible shapes fall back to
+    replication with the reason recorded (``parallel.shard_fallbacks``
+    + the ``program.shard-fallback`` finding), and ``replicate(var)``
+    opts a var back out.
 
     ``programs`` (e.g. the startup program) receive the same tags by
     variable name so their out-shardings create the parameters
@@ -380,12 +465,24 @@ def shard_fsdp(program, programs=()):
         return _fallback_empty(
             "repeated structure has no per-layer Parameters — "
             "parameters stay replicated")
+    # prologue/epilogue: every 2-D Parameter outside the scan groups
+    # (embedding tables, the LM head) shards its leading dim over the
+    # composed ('fsdp', 'tp') extent — consumed outside the scan body,
+    # so the gather lands outside the loop, once per step
+    prologue = set()
+    for var in block.vars.values():
+        if (isinstance(var, Parameter) and var.name not in names
+                and len(var.shape or ()) == 2
+                and getattr(var, "fsdp_param", None) is not False):
+            prologue.add(var.name)
     for prog in (program, *programs):
         blk = prog.global_block()
-        for n in names:
+        for n in names | prologue:
             v = blk._find_var(n)
             if v is not None:
                 v.fsdp_param = True
+                if n in prologue:
+                    v.fsdp_axes = ("fsdp", "tp")
         # the gather-vs-replicate schedule decision
         # (memory_optimize(policy="auto") -> program._fsdp) must
         # resolve identically for every program touching these vars —
@@ -393,7 +490,7 @@ def shard_fsdp(program, programs=()):
         # expects them replicated is a compile-time sharding mismatch
         if hasattr(program, "_fsdp"):
             prog._fsdp = program._fsdp
-    return sorted(names)
+    return sorted(names | prologue)
 
 
 def shard_activation(var, spec):
@@ -481,13 +578,14 @@ def sharding_report(program, mesh):
     * ``opt_state`` — optimizer-owned persistables (``optimizer_state``
       tag: accumulators, beta-pows, lr — ZeRO-1/3 territory);
     * ``grads``     — one transient gradient per parameter, accounted at
-      the parameter's EXPLICIT spec — the spec the Executor actually
-      pins each gradient to at the backward/optimizer boundary.  This
-      is deliberately NOT the fsdp-composed resolution: gradients stay
-      replicated over ``fsdp`` (pinning them sharded lets GSPMD reshard
-      shared forward subcomputations and breaks bit-exactness at the
-      ulp level); the sharded-gradient reduce-scatter spelling is the
-      ROADMAP item-2 remainder.
+      the spec the Executor actually pins each gradient to at the
+      backward/optimizer boundary.  Under the default reduce-scatter
+      spelling (``PADDLE_TPU_ZERO3_RS=1``) an fsdp-tagged parameter's
+      gradient resolves through ``grad_rs_spec_for`` to the composed
+      fsdp spec — each chip holds only its shard after the boundary
+      reduce-scatter; with the kill switch off (or on a shard
+      fallback) it is the parameter's EXPLICIT spec, i.e. replicated
+      over ``fsdp``.
 
     Each section carries ``total_bytes`` (the logical, fully-replicated
     figure), ``per_device_bytes`` under the resolved specs,
@@ -519,11 +617,14 @@ def sharding_report(program, mesh):
         resolved = _var_shard_bytes(var, mesh, mesh_sizes, block)
         for s in sections:
             if s == "grads":
-                # the boundary pin's spec: explicit (tp) only, never
-                # fsdp-composed — see the docstring
+                # the boundary pin's spec: the composed reduce-scatter
+                # resolution when ZERO3_RS is on, else explicit (tp)
+                # only — mirrors the Executor's pin exactly
+                rs = grad_rs_spec_for(var, mesh, block)
                 nbytes, per_dev, spec = _var_shard_bytes(
                     var, mesh, mesh_sizes, block,
-                    spec=getattr(var, "partition_spec", None) or P())
+                    spec=(rs if rs is not None else
+                          getattr(var, "partition_spec", None) or P()))
             else:
                 nbytes, per_dev, spec = resolved
             sec = out[s]

@@ -12,7 +12,7 @@ from ..analysis.comm import CommContract
 from .mesh import axis_size
 
 __all__ = ["one_boundary_reduce_contract", "fsdp_scan_contract",
-           "training_step_contract"]
+           "zero3_grad_contract", "training_step_contract"]
 
 
 def one_boundary_reduce_contract(mesh=None, axis="dp"):
@@ -46,12 +46,54 @@ def fsdp_scan_contract(mesh=None):
     return c
 
 
-def training_step_contract(mesh, accum=False, fsdp=False):
+def zero3_grad_contract(mesh=None, n_grads=None):
+    """The true-ZeRO-3 gradient invariant (docs/parallel.md rule 4 —
+    "reduce-scatter at the boundary, never in-loop"): every fsdp-tagged
+    parameter's gradient aggregates as ONE boundary-level
+    ``reduce-scatter@fsdp`` (the ``pt_pin[grad_rs_boundary]`` site —
+    each chip receives only its gradient shard, at shard volume), and
+    reduce-class collectives stay out of every loop body — the in-loop
+    per-layer dW replication the replicated-grad spelling was shipped
+    to avoid must not sneak back in with the scatter.
+
+    ``n_grads`` pins the exact reduce-scatter count (one per fsdp-tagged
+    parameter whose spec resolved — pass ``len(shard_fsdp(...))`` on a
+    fully divisible model); without it the contract expects at least
+    one.  Because 'reduce' is a kind CLASS covering reduce-scatter, the
+    in-loop forbid also catches a mis-spelled in-loop scatter.
+
+    On a mesh with a tp axis the in-loop forbid narrows: tp's per-layer
+    all-reduces are forward MATH (row-parallel matmul partials — which
+    under the ``(tp, fsdp)`` tuple composition of a row-sharded weight
+    legitimately reduce over fsdp too), not gradient aggregation.  What
+    stays forbidden in-loop there is any reduce over ``dp`` (gradient
+    aggregation has exactly one home: the boundary) and any
+    reduce-SCATTER at all (a scatter inside the loop is always the
+    mis-spelled ZeRO-3 this contract exists to catch)."""
+    c = CommContract("zero3-grad-reduce-scatter")
+    if mesh is not None and axis_size(mesh, "tp") > 1:
+        c.forbid(kind="reduce", axis="dp", in_loop=True)
+        c.forbid(kind="reduce-scatter", in_loop=True)
+    else:
+        c.forbid(kind="reduce", in_loop=True)
+    expect_axis = "fsdp" if (mesh is None
+                             or axis_size(mesh, "fsdp") > 1) else None
+    kw = {"count": n_grads} if n_grads else {"min_count": 1}
+    c.expect(kind="reduce-scatter", axis=expect_axis, in_loop=False,
+             phase="boundary", **kw)
+    return c
+
+
+def training_step_contract(mesh, accum=False, fsdp=False,
+                           grad_rs=False):
     """The full audited comm shape of one training step on ``mesh``:
     one boundary gradient reduction over ``dp`` (when the mesh has a
-    dp axis of size > 1), zero in-loop reduces, and — with ``fsdp`` —
-    the in-loop weight gathers FSDP exists to place there.  Returns a
-    list of contracts to attach."""
+    dp axis of size > 1), zero in-loop reduces, with ``fsdp`` the
+    in-loop weight gathers FSDP exists to place there, and with
+    ``grad_rs`` (the default PADDLE_TPU_ZERO3_RS spelling on an fsdp
+    mesh) the boundary gradient reduce-scatters of
+    :func:`zero3_grad_contract`.  Returns a list of contracts to
+    attach."""
     out = []
     if axis_size(mesh, "dp") > 1:
         out.append(one_boundary_reduce_contract(mesh))
@@ -62,4 +104,8 @@ def training_step_contract(mesh, accum=False, fsdp=False):
         out.append(c)
     if fsdp and axis_size(mesh, "fsdp") > 1:
         out.append(fsdp_scan_contract(mesh))
+        if grad_rs and axis_size(mesh, "dp") > 1:
+            # the RS spelling needs a boundary reduce to scatter
+            # (grad_rs_spec_for resolves None on fsdp-only meshes)
+            out.append(zero3_grad_contract(mesh))
     return out

@@ -90,6 +90,13 @@ def geometry_fingerprint():
               for t in (96, 2048, 4096, 16384)
               for c in (128, 256, 512, 1024, 2048)),
         _registry_surface(),
+        # the schedule-dimension surface: which non-geometry knobs a
+        # persisted gpt_step winner can carry.  Adding a dimension
+        # (grad_rs joined with the true-ZeRO-3 gradient spelling,
+        # docs/parallel.md rule 4) changes what an OLD winner means —
+        # it was measured with the dimension pinned at its default —
+        # so the fingerprint must move and retire it.
+        ("policy", "accum", "fsdp", "grad_rs"),
     )
     return hashlib.sha256(repr(basis).encode()).hexdigest()[:12]
 

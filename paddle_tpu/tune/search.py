@@ -64,6 +64,23 @@ def _diag_w(width):
         pa.DIAG_W = old
 
 
+@contextlib.contextmanager
+def _zero3_rs_env(value):
+    """Temporarily pin PADDLE_TPU_ZERO3_RS while a candidate compiles
+    (``parallel.api.grad_rs_spec_for`` reads it at trace time; the
+    search is single-threaded).  Restores the caller's setting —
+    including absence — on exit."""
+    old = os.environ.get("PADDLE_TPU_ZERO3_RS")
+    os.environ["PADDLE_TPU_ZERO3_RS"] = str(value)
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("PADDLE_TPU_ZERO3_RS", None)
+        else:
+            os.environ["PADDLE_TPU_ZERO3_RS"] = old
+
+
 def flagship_dims():
     """The GPT flagship model dims (bench.py's BENCH_GPT_* envs win) —
     the ONE env-default table bench.py and the tune entry points share,
@@ -118,6 +135,13 @@ def _measure_candidate(cand, *, seq_len, n_layer, d_model, n_head, vocab,
         # search times both spellings identically but still persists
         # the winner's choice for memory_optimize(policy="auto"))
         main_prog._fsdp = bool(cand["fsdp"])
+    # the true-ZeRO-3 gradient-spelling dimension (docs/parallel.md
+    # rule 4): grad_rs_spec_for reads PADDLE_TPU_ZERO3_RS at trace
+    # time, so the override wraps the whole compile/measure phase —
+    # like fsdp, a single-chip search times both spellings identically
+    # but the winner's choice still persists for a mesh-bound consumer
+    rs_ctx = (_zero3_rs_env("1" if cand["grad_rs"] else "0")
+              if "grad_rs" in cand else contextlib.nullcontext())
     rng = np.random.default_rng(17)
     toks = rng.integers(0, vocab, (batch, seq_len)).astype(np.int64)
     feed = {"tokens": toks, "labels": np.roll(toks, -1, axis=1)}
@@ -135,7 +159,7 @@ def _measure_candidate(cand, *, seq_len, n_layer, d_model, n_head, vocab,
         backend_ctx = (forced_backend(cand["backend"])
                        if cand.get("backend")
                        else contextlib.nullcontext())
-        with backend_ctx:
+        with backend_ctx, rs_ctx:
             exe.run(startup, scope=scope)
             with _diag_w(cand.get("diag_w")):
                 cost = exe.compile_only(main_prog, feed=feed,
@@ -191,7 +215,8 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
                   dtype="bfloat16", fused_head=True, steps=2, warmup=1,
                   repeats=3, budget_bytes=None, block_caps=None,
                   policies=POLICY_ORDER, accums=(1,), diag_ws=(256,),
-                  fsdp_opts=(None,), backends=None, max_measure=8,
+                  fsdp_opts=(None,), grad_rs_opts=(None,),
+                  backends=None, max_measure=8,
                   learning_rate=1e-3, force=False, mode=None):
     """Search (or serve from cache) the step schedule for one GPT shape.
 
@@ -240,7 +265,9 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
     cands = schedule_candidates(seq_len, d_model // n_head, n_head,
                                 block_caps=block_caps, policies=policies,
                                 accums=accums or (1,), diag_ws=diag_ws,
-                                fsdp_opts=fsdp_opts, backends=backends)
+                                fsdp_opts=fsdp_opts,
+                                grad_rs_opts=grad_rs_opts,
+                                backends=backends)
     report["candidates"] = len(cands)
     hbm_model = lambda c: estimate_gpt_step_hbm(
         n_layer, d_model, n_head, vocab, seq_len, batch,
@@ -321,7 +348,7 @@ def tune_gpt_step(seq_len, n_layer, d_model, n_head, vocab, batch,
     win = min(timed, key=lambda m: m["median_s"])
     config = {k: win[k] for k in ("block_q", "block_k", "diag_w",
                                   "packed", "policy", "accum", "fsdp",
-                                  "backend")
+                                  "grad_rs", "backend")
               if k in win and win[k] is not None}
     meas = {k: win[k] for k in ("median_s", "tok_s", "flops",
                                 "bytes_accessed", "hbm_high_water_bytes",

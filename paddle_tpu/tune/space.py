@@ -186,7 +186,7 @@ def attention_candidates(seq_len, d_head, n_head, block_caps=None,
 def schedule_candidates(seq_len, d_head, n_head, block_caps=None,
                         policies=POLICY_ORDER, accums=(1, 2),
                         diag_ws=(256,), fsdp_opts=(None,),
-                        backends=None):
+                        grad_rs_opts=(None,), backends=None):
     """The step-schedule candidate list: kernel geometry x remat policy
     x gradient-accumulation factor (x FSDP gather-vs-replicate when the
     caller is tuning a mesh with an ``fsdp`` axis: ``fsdp_opts=(False,
@@ -194,7 +194,16 @@ def schedule_candidates(seq_len, d_head, n_head, block_caps=None,
     inside the measured search instead of hardcoded; ``None`` entries
     leave the key off the candidate, the single-chip default; x the
     kernel-registry ``backends`` when given — the autotuner picks
-    KERNELS, not just block shapes, docs/kernels.md)."""
+    KERNELS, not just block shapes, docs/kernels.md).
+
+    ``grad_rs_opts=(False, True)`` adds the true-ZeRO-3 gradient
+    spelling (docs/parallel.md rule 4) as a measured dimension on fsdp
+    candidates: reduce-scatter at the boundary cuts boundary comm bytes
+    by the fsdp degree but GSPMD pays extra in-loop weight gathers for
+    the shard-sized carry, so which spelling wins is geometry- and
+    interconnect-dependent — measured, not derived.  Crossed only with
+    ``fsdp=True`` candidates (without fsdp sharding there is no shard
+    to scatter to; the dimension would measure duplicates)."""
     out = []
     for geo in attention_candidates(seq_len, d_head, n_head,
                                     block_caps=block_caps,
@@ -204,12 +213,15 @@ def schedule_candidates(seq_len, d_head, n_head, block_caps=None,
         for pol in policies:
             for acc in accums:
                 for fs in fsdp_opts:
-                    c = dict(geo)
-                    c["policy"] = pol
-                    c["accum"] = int(acc)
-                    if fs is not None:
-                        c["fsdp"] = bool(fs)
-                    out.append(c)
+                    for rs in (grad_rs_opts if fs else (None,)):
+                        c = dict(geo)
+                        c["policy"] = pol
+                        c["accum"] = int(acc)
+                        if fs is not None:
+                            c["fsdp"] = bool(fs)
+                        if rs is not None:
+                            c["grad_rs"] = bool(rs)
+                        out.append(c)
     return out
 
 

@@ -31,13 +31,13 @@ def _m_first_tagged(program):
 
 
 def _build_gpt(n_layer=3, d_model=64, accum=1, memopt=True,
-               dropout=0.0):
+               dropout=0.0, vocab=VOCAB):
     pt.core.unique_name.reset()
     main, startup = pt.Program(), pt.Program()
     main.random_seed = 7
     with pt.program_guard(main, startup):
         outs = transformer.build(
-            vocab_size=VOCAB, n_layer=n_layer, n_head=HEADS,
+            vocab_size=vocab, n_layer=n_layer, n_head=HEADS,
             d_model=d_model, max_len=SEQ, dropout_rate=dropout,
             dtype="float32", learning_rate=1e-2)
     if memopt:
@@ -47,20 +47,23 @@ def _build_gpt(n_layer=3, d_model=64, accum=1, memopt=True,
     return main, startup, outs
 
 
-def _gpt_feed(batch=16, seed=5):
+def _gpt_feed(batch=16, seed=5, vocab=VOCAB):
     rng = np.random.default_rng(seed)
-    toks = rng.integers(0, VOCAB, (batch, SEQ)).astype(np.int64)
+    toks = rng.integers(0, vocab, (batch, SEQ)).astype(np.int64)
     lbls = np.roll(toks, -1, axis=1)
     lbls[:, -1] = -1
     return {"tokens": toks, "labels": lbls}
 
 
 def _train(mesh, fsdp_env, build_kwargs=None, steps=3, batch=16,
-           dp_axis="dp", tp=False, grad_fetch=True):
-    """Train on ``mesh`` with PADDLE_TPU_FSDP=``fsdp_env``; returns
-    (losses, grads, params, cost, accum_plan, remat_plan, report, exe,
-    main, tagged)."""
+           dp_axis="dp", tp=False, grad_fetch=True, rs=None):
+    """Train on ``mesh`` with PADDLE_TPU_FSDP=``fsdp_env`` (and, when
+    ``rs`` is given, PADDLE_TPU_ZERO3_RS=``rs``); returns
+    (losses, grads, params, cost, accum_plan, remat_plan, report, scope,
+    main, tagged, comm_plan)."""
     os.environ["PADDLE_TPU_FSDP"] = fsdp_env
+    if rs is not None:
+        os.environ["PADDLE_TPU_ZERO3_RS"] = rs
     try:
         main, startup, outs = _build_gpt(**(build_kwargs or {}))
         if tp:
@@ -77,7 +80,9 @@ def _train(mesh, fsdp_env, build_kwargs=None, steps=3, batch=16,
             fetch = [outs["avg_cost"]]
             if grad_fetch and tagged:
                 fetch += [tagged[0] + "@GRAD", "lm_head.w@GRAD"]
-            feed = _gpt_feed(batch=batch)
+            feed = _gpt_feed(batch=batch,
+                             vocab=(build_kwargs or {}).get("vocab",
+                                                            VOCAB))
             losses, grads = [], []
             for _ in range(steps):
                 r = exe.run(main, feed=feed, fetch_list=fetch,
@@ -90,11 +95,13 @@ def _train(mesh, fsdp_env, build_kwargs=None, steps=3, batch=16,
                     exe.last_accum_plan,
                     list(getattr(exe, "last_remat_plan", []) or []),
                     papi.sharding_report(main, mesh), scope, main,
-                    tagged)
+                    tagged, exe.last_comm_plan)
         finally:
             pt.core.scope._scope_stack.pop()
     finally:
         os.environ.pop("PADDLE_TPU_FSDP", None)
+        if rs is not None:
+            os.environ.pop("PADDLE_TPU_ZERO3_RS", None)
 
 
 # -- fsdp_spec_for rules ----------------------------------------------------
@@ -172,21 +179,25 @@ def test_zero_spec_inherits_fsdp_composition():
 
 
 def test_shard_fsdp_tags_per_layer_params():
-    """The structural matcher tags exactly the per-layer (scan-stacked)
-    weights — embeddings, the LM head and ln_f stay untagged — on the
-    startup program too."""
+    """The structural matcher tags the per-layer (scan-stacked) weights
+    PLUS the prologue/epilogue 2-D tables (embedding table, positional
+    table, LM head — the fully-sharded-everything discipline, their
+    gathers live outside the scan) — on the startup program too."""
     main, startup, _ = _build_gpt(n_layer=3)
     tagged = papi.shard_fsdp(main, programs=(startup,))
-    assert len(tagged) == 3 * 16  # 16 per-layer params per period
+    # 16 per-layer params per period + tok_emb.w/pos_emb.w.w/lm_head.w
+    assert len(tagged) == 3 * 16 + 3
     # the period tiling may rotate (an LN pairs with the next block's
     # attention), so ln_f can legitimately ride the last scan
     # iteration — but embeddings and the LM head never repeat
-    assert all(t.startswith(("block", "ln_f")) for t in tagged), tagged
     assert sum(t.startswith("block") for t in tagged) >= 3 * 14
-    for name in ("tok_emb.w", "lm_head.w"):
-        assert name not in tagged
+    for name in ("tok_emb.w", "pos_emb.w.w", "lm_head.w"):
+        assert name in tagged
         var = main.global_block()._find_var(name)
-        assert var is None or not getattr(var, "fsdp_param", False)
+        assert var is not None and var.fsdp_param
+        # prologue tables carry the (fsdp, tp) composition so a free
+        # tp axis joins the leading-dim shard on tp meshes
+        assert var.fsdp_axes == ("fsdp", "tp")
     svar = startup.global_block()._find_var(tagged[0])
     assert svar is not None and svar.fsdp_param
     # replicate() opts a var back out
@@ -197,11 +208,13 @@ def test_shard_fsdp_tags_per_layer_params():
 
 def test_shard_fsdp_without_remat_segments():
     """No memory_optimize marks: shard_fsdp falls back to the
-    detect_repeated_run tiling and still finds the layer weights."""
+    detect_repeated_run tiling and still finds the layer weights (and
+    the prologue tables, which never depended on the segments)."""
     main, startup, _ = _build_gpt(n_layer=2, memopt=False)
     tagged = papi.shard_fsdp(main, programs=(startup,))
-    assert len(tagged) == 2 * 16
-    assert all(t.startswith("block") for t in tagged)
+    assert len(tagged) == 2 * 16 + 3
+    assert all(t.startswith("block") for t in tagged
+               if t not in ("tok_emb.w", "pos_emb.w.w", "lm_head.w"))
 
 
 def test_shard_fsdp_empty_is_recorded(monkeypatch):
@@ -241,14 +254,22 @@ def test_sharding_report_accounting():
     papi.shard_fsdp(main, programs=(startup,))
     rep = papi.sharding_report(main, mesh)
     p = rep["params"]
-    assert p["sharded_vars"] == 3 * 16
+    assert p["sharded_vars"] == 3 * 16 + 3
     assert p["per_device_bytes"] * 2 <= p["total_bytes"]
     assert p["replicated_per_device_bytes"] == p["total_bytes"]
-    # grads account at the EXPLICIT spec (replicated here): the
-    # boundary pin deliberately never composes fsdp — the
-    # reduce-scatter gradient spelling is the ROADMAP remainder
-    assert rep["grads"]["per_device_bytes"] == (
+    # grads account at the boundary pin's spec: under the default
+    # reduce-scatter spelling (docs/parallel.md rule 4) each chip holds
+    # only its shard of every fsdp-tagged gradient...
+    assert rep["grads"]["per_device_bytes"] * 2 <= (
         rep["grads"]["total_bytes"])
+    # ...and the kill switch restores the replicated-grad accounting
+    os.environ["PADDLE_TPU_ZERO3_RS"] = "0"
+    try:
+        rep_rs0 = papi.sharding_report(main, mesh)
+        assert rep_rs0["grads"]["per_device_bytes"] == (
+            rep_rs0["grads"]["total_bytes"])
+    finally:
+        os.environ.pop("PADDLE_TPU_ZERO3_RS", None)
     assert rep["total_bytes"] == (
         p["total_bytes"] + rep["opt_state"]["total_bytes"]
         + rep["grads"]["total_bytes"])
@@ -269,10 +290,10 @@ def test_fsdp_bitexact_dp_fsdp_mesh():
     bit-exact vs the PADDLE_TPU_FSDP=0 replicated spelling."""
     mesh = _mesh({"dp": 2, "fsdp": 4})
     kw = dict(build_kwargs={"accum": 4}, steps=3)
-    l1, g1, p1, c1, plan1, remat1, rep1, scope1, _m, tagged = _train(
-        mesh, "1", **kw)
-    l0, g0, p0, c0, _plan0, remat0, rep0, _s0, _m0, _t0 = _train(
-        mesh, "0", **kw)
+    l1, g1, p1, c1, plan1, remat1, rep1, scope1, _m, tagged, cp1 = (
+        _train(mesh, "1", **kw))
+    l0, g0, p0, c0, _plan0, remat0, rep0, _s0, _m0, _t0, _cp0 = (
+        _train(mesh, "0", **kw))
 
     assert [g for g in remat1 if g.get("fsdp")], remat1
     assert all(not g.get("fsdp") for g in remat0), remat0
@@ -280,9 +301,15 @@ def test_fsdp_bitexact_dp_fsdp_mesh():
     assert c1["reduce_ops_in_loop"] == 0
     gathers_in = c1["collectives_in_loop"] - c1["reduce_ops_in_loop"]
     assert gathers_in > 0
-    # the boundary reduce set is unchanged by fsdp: one gradient
-    # reduction per optimizer step either way
-    assert c1["reduce_ops"] == c0["reduce_ops"]
+    # boundary discipline under the default reduce-scatter spelling
+    # (docs/parallel.md rule 4): every reduce stays at the boundary;
+    # each fsdp-tagged grad's full-volume all-reduce@dp becomes one
+    # reduce-scatter (count preserved) plus one scalar grad-norm
+    # partial all-reduce@fsdp, so the set grows by exactly len(tagged)
+    assert c1["reduce_ops"] == c0["reduce_ops"] + len(tagged)
+    rs_ops = cp1.select(kind="reduce-scatter", axis="fsdp",
+                        in_loop=False)
+    assert len(rs_ops) == len(tagged)
 
     assert rep1["params"]["per_device_bytes"] * 2 <= (
         rep1["params"]["total_bytes"])
@@ -308,10 +335,10 @@ def test_fsdp_bitexact_dp_fsdp_tp_mesh():
     ZeRO bit-exactness contract still holds."""
     mesh = _mesh({"dp": 2, "fsdp": 2, "tp": 2})
     kw = dict(build_kwargs={"accum": 1}, steps=2, tp=True)
-    l1, g1, p1, c1, _plan1, remat1, rep1, _s1, main, tagged = _train(
-        mesh, "1", **kw)
-    l0, g0, p0, _c0, _plan0, _r0, rep0, _s0, _m0, _t0 = _train(
-        mesh, "0", **kw)
+    l1, g1, p1, c1, _plan1, remat1, rep1, _s1, main, tagged, _cp1 = (
+        _train(mesh, "1", **kw))
+    l0, g0, p0, _c0, _plan0, _r0, rep0, _s0, _m0, _t0, _cp0 = (
+        _train(mesh, "0", **kw))
     assert [g for g in remat1 if g.get("fsdp")], remat1
     block = main.global_block()
     ffn2 = block.vars["block0_ffn2.w"]
@@ -334,7 +361,10 @@ def test_fsdp_bitexact_dp_fsdp_tp_mesh():
         np.testing.assert_allclose(a, b, rtol=2e-6, atol=0)
     for ga, gb in zip(g1, g0):
         for a, b in zip(ga, gb):
-            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+            # atol admits the LM head's near-zero elements: the head is
+            # now itself fsdp-sharded (fully-sharded prologue), so its
+            # gradient picks up the same ulp-level reassociation
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=5e-7)
     for k in p1:
         if k.endswith("_att_k.b"):
             continue  # zero-true-gradient: trajectory is sign-of-noise
@@ -349,8 +379,8 @@ def test_fsdp_indivisible_fallback_bitexact():
     mesh = _mesh({"fsdp": 8})
     kw = dict(build_kwargs={"n_layer": 2, "d_model": 36}, steps=2,
               dp_axis=None, batch=8)
-    l1, g1, p1, _c1, _plan1, remat1, rep1, _s1, main, tagged = _train(
-        mesh, "1", **kw)
+    l1, g1, p1, _c1, _plan1, remat1, rep1, _s1, main, tagged, _cp1 = (
+        _train(mesh, "1", **kw))
     l0, g0, p0, *_ = _train(mesh, "0", **kw)
     block = main.global_block()
     recs = getattr(block, "_shard_fallbacks", {})
@@ -378,6 +408,128 @@ def test_fsdp_indivisible_fallback_bitexact():
     found = report.by_check("program.shard-fallback")
     assert found and all(f.severity == "info" for f in found)
     assert any("fsdp" in f.message for f in found)
+
+
+# -- rule 4: the reduce-scatter gradient spelling ---------------------------
+@pytest.mark.parametrize("case", ["dp_fsdp", "dp_fsdp_tp",
+                                  "fsdp_only_indivisible"])
+def test_zero3_rs_bitexact(case):
+    """The true-ZeRO-3 gradient spelling vs its PADDLE_TPU_ZERO3_RS=0
+    replicated-grad reference, bit-exact across mesh geometries
+    (docs/parallel.md rule 4):
+
+    * dp x fsdp — one boundary reduce-scatter@fsdp per tagged grad,
+      zero in-loop reduces (``zero3_grad_contract``), grad bytes/device
+      below replicated;
+    * dp x fsdp x tp — the scatter composes with the tp rules;
+    * fsdp-only with an indivisible embedding (vocab=61, d_model=36) —
+      no dp axis means no boundary reduce to scatter, so the spelling
+      is INERT by design (a bare scatter constraint measurably drifts
+      under ``reduce_each`` accumulation), the indivisible tables take
+      the recorded replication fallback, and both spellings stay
+      bit-exact trivially.
+    """
+    if case == "dp_fsdp":
+        mesh = _mesh({"dp": 2, "fsdp": 4})
+        kw = dict(build_kwargs={"accum": 4}, steps=3)
+    elif case == "dp_fsdp_tp":
+        mesh = _mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        kw = dict(build_kwargs={"accum": 4}, steps=3, tp=True)
+    else:
+        mesh = _mesh({"fsdp": 8})
+        kw = dict(build_kwargs={"n_layer": 2, "d_model": 36,
+                                "vocab": 61, "accum": 4},
+                  steps=2, dp_axis=None, batch=8)
+    l1, g1, p1, _c1, _pl1, _r1, rep1, _s1, main, tagged, cp1 = _train(
+        mesh, "1", rs="1", **kw)
+    l0, g0, p0, _c0, _pl0, _r0, rep0, _s0, _m0, _t0, cp0 = _train(
+        mesh, "1", rs="0", **kw)
+
+    # the kill switch restores the replicated-grad spelling exactly
+    assert not cp0.select(kind="reduce-scatter")
+    if case == "dp_fsdp_tp":
+        # tp grads are naturally tp-sharded either way; the scatter
+        # still shrinks the per-device gradient residency further
+        assert rep1["grads"]["per_device_bytes"] < (
+            rep0["grads"]["per_device_bytes"])
+    else:
+        assert rep0["grads"]["per_device_bytes"] == (
+            rep0["grads"]["total_bytes"])
+
+    if case == "fsdp_only_indivisible":
+        # no dp axis: grad_rs_spec_for resolves None, both plans agree
+        assert not cp1.select(kind="reduce-scatter")
+        block = main.global_block()
+        assert papi.grad_rs_spec_for(
+            block.vars["block0_ffn2.w"], mesh, block) is None
+        assert rep1["grads"]["per_device_bytes"] == (
+            rep1["grads"]["total_bytes"])
+        recs = getattr(block, "_shard_fallbacks", {})
+        assert ("tok_emb.w", "fsdp") in recs
+        assert ("lm_head.w", "fsdp") in recs
+        from paddle_tpu.analysis import lint
+
+        report = lint(main, levels=("program",),
+                      checks=("program.shard-fallback",))
+        found = report.by_check("program.shard-fallback")
+        # (the finding list caps at MAX_FINDINGS and this model falls
+        # back a lot, so assert the check fires rather than hunting the
+        # prologue entries — recs above already names them)
+        assert found and all(f.severity == "info" for f in found)
+        assert any(f.data.get("axis") == "fsdp" for f in found)
+    else:
+        from paddle_tpu.parallel.contracts import zero3_grad_contract
+
+        viol = zero3_grad_contract(mesh).check(cp1)
+        assert not viol, viol
+        rs_ops = cp1.select(kind="reduce-scatter", axis="fsdp",
+                            in_loop=False)
+        assert rs_ops
+        # one scatter per tagged grad whose spec resolved, each
+        # carrying its pt_pin[grad_rs_boundary:<name>] provenance
+        block = main.global_block()
+        sites = {s for op in rs_ops for s in op.provenance_names()
+                 if s.startswith("grad_rs_boundary:")}
+        expected = {f"grad_rs_boundary:{n}" for n in tagged
+                    if papi.grad_rs_spec_for(
+                        block._find_var(n), mesh, block) is not None}
+        assert sites == expected
+        assert rep1["grads"]["per_device_bytes"] < (
+            rep1["grads"]["total_bytes"])
+
+    for a, b in zip(l1, l0):
+        assert np.array_equal(a, b)
+    for ga, gb in zip(g1, g0):
+        for a, b in zip(ga, gb):
+            assert np.array_equal(a, b)
+    for k in p1:
+        assert np.array_equal(p1[k], p0[k]), k
+
+
+def test_grad_rs_spec_for_rules(monkeypatch):
+    """Rule-4 spec resolution: needs the kill switch on, a mesh with
+    both dp>1 and fsdp axes, and an fsdp-tagged divisible shape."""
+    main, _startup, _ = _build_gpt(memopt=False)
+    block = main.global_block()
+    w = block.vars["block0_ffn1.w"]
+    mesh = _mesh({"dp": 2, "fsdp": 4})
+    assert papi.grad_rs_spec_for(w, mesh, block) is None  # untagged
+    w.fsdp_param = True
+    assert papi.grad_rs_spec_for(w, mesh, block) == P("fsdp", None)
+    # the grad spec IS the parameter's composed fsdp spec
+    assert papi.grad_rs_spec_for(w, mesh, block) == (
+        papi.fsdp_spec_for(w, mesh, block))
+    # kill switch
+    monkeypatch.setenv("PADDLE_TPU_ZERO3_RS", "0")
+    assert papi.grad_rs_spec_for(w, mesh, block) is None
+    monkeypatch.delenv("PADDLE_TPU_ZERO3_RS")
+    # a reduce-scatter needs a boundary reduce: no dp axis (or size-1
+    # dp) resolves None even though the param itself shards
+    assert papi.grad_rs_spec_for(w, _mesh({"fsdp": 8}), block) is None
+    assert papi.fsdp_spec_for(w, _mesh({"fsdp": 8}), block) is not None
+    # FSDP off entirely -> None (rides fsdp_spec_for's own gates)
+    monkeypatch.setenv("PADDLE_TPU_FSDP", "0")
+    assert papi.grad_rs_spec_for(w, mesh, block) is None
 
 
 def test_fsdp_kill_switch_and_auto_policy(monkeypatch):
@@ -464,5 +616,39 @@ def test_tune_search_persists_fsdp_dimension(tmp_path, monkeypatch):
                               dtype="float32", learning_rate=1e-2)
         pt.memory_optimize(main, policy="auto")
         assert main._fsdp is False
+    finally:
+        tune.reset_cache()
+
+
+def test_tune_search_persists_grad_rs_dimension(tmp_path, monkeypatch):
+    """The measured grad_rs dimension (boundary reduce-scatter vs
+    replicated grads — a real volume-vs-gather tradeoff on fsdp meshes)
+    crosses only with fsdp=True candidates, rides _measure_candidate
+    through the PADDLE_TPU_ZERO3_RS pin, and the winner persists the
+    key in the tune cache."""
+    from paddle_tpu import tune
+    from paddle_tpu.tune import schedule_candidates
+
+    # grad_rs never crosses with replicate-schedule candidates
+    cands = schedule_candidates(SEQ, 16, HEADS, fsdp_opts=(False, True),
+                                grad_rs_opts=(False, True))
+    assert all("grad_rs" not in c for c in cands if not c["fsdp"])
+    assert ({c["grad_rs"] for c in cands if c["fsdp"]}
+            == {False, True})
+
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuned.json"))
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "search")
+    tune.reset_cache()
+    try:
+        rep = tune.tune_gpt_step(
+            seq_len=16, n_layer=2, d_model=32, n_head=2, vocab=61,
+            batch=4, dtype="float32", steps=1, warmup=0, repeats=1,
+            block_caps=(16,), diag_ws=(16,), policies=("none",),
+            accums=(1,), fsdp_opts=(True,), grad_rs_opts=(False,),
+            max_measure=2)
+        assert rep["source"] == "search", rep
+        assert rep["entry"]["config"]["fsdp"] is True
+        assert rep["entry"]["config"]["grad_rs"] is False
     finally:
         tune.reset_cache()

@@ -260,8 +260,14 @@ def test_serving_request_span_tree_sums_to_e2e(tracer):
     params = _make_params()
     eng = ServingEngine(params, 2, 2, 32, max_len=32, max_slots=2,
                         decode_chunk=2, min_bucket=4)
-    # compiles paid outside the traced window
-    eng.generate_many([np.arange(1, 4, dtype=np.int32)], max_new_tokens=2)
+    # warm the SAME shapes the traced request will use (a length-5
+    # prompt lands in the bucket-8 prefill, not the warmup-3 bucket-4
+    # one) with disjoint tokens so the prefix cache cannot shortcut the
+    # timed prefill — every AOT compile, including the one
+    # ``fn.prepare`` pays between admission and the prefill window, is
+    # spent here, outside the traced request
+    eng.generate_many([np.arange(10, 15, dtype=np.int32)],
+                      max_new_tokens=8)
     tracer.clear()
     req = eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=8)
     eng.run_until_idle()
@@ -273,14 +279,35 @@ def test_serving_request_span_tree_sums_to_e2e(tracer):
     names = {e["name"] for e in kids}
     assert names >= {"serving.req.queue", "serving.req.prefill",
                      "serving.req.decode_chunk", "serving.req.evict"}
-    # children nest within the root and their durations sum to e2e
-    # within tolerance (the gaps are host scheduling between chunks)
+    # children nest within the root; the tree is built from the request
+    # handle's own timestamps, so containment is exact — only the wall
+    # seconds BETWEEN spans (host scheduling, compile walls) vary by
+    # host, and no assertion here depends on them
     for e in kids:
         assert e["ts"] >= root["ts"] - 1e-3
         assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1e-3
+    # the phases tile the request in order: queue ends before prefill
+    # starts, decode chunks follow prefill sorted and non-overlapping,
+    # and the zero-duration evict marker closes the root window
+    queue = next(e for e in kids if e["name"] == "serving.req.queue")
+    prefill = next(e for e in kids if e["name"] == "serving.req.prefill")
+    chunks = sorted((e for e in kids
+                     if e["name"] == "serving.req.decode_chunk"),
+                    key=lambda e: e["ts"])
+    evict = next(e for e in kids if e["name"] == "serving.req.evict")
+    assert queue["ts"] + queue["dur"] <= prefill["ts"] + 1e-3
+    assert chunks and prefill["ts"] + prefill["dur"] <= chunks[0]["ts"] + 1e-3
+    for a, b in zip(chunks, chunks[1:]):
+        assert a["ts"] + a["dur"] <= b["ts"] + 1e-3
+    # 7 post-prefill tokens at decode_chunk=2 -> 4 chunks
+    assert len(chunks) == 4
+    assert evict["dur"] == 0
+    assert evict["ts"] == pytest.approx(root["ts"] + root["dur"], abs=1.0)
+    # disjoint children can never exceed the root window they tile
     cover = sum(e["dur"] for e in kids)
-    assert 0.5 * root["dur"] <= cover <= 1.001 * root["dur"]
-    # root duration IS the request e2e (microseconds vs seconds)
+    assert cover <= 1.001 * root["dur"]
+    # root duration IS the request e2e (microseconds vs seconds) — two
+    # views of the same submit->finish timestamps
     assert root["dur"] == pytest.approx(req.e2e * 1e6, rel=0.05)
 
 
@@ -309,7 +336,13 @@ def test_serving_ttft_decomposition(tracer):
     params = _make_params()
     eng = ServingEngine(params, 2, 2, 32, max_len=32, max_slots=2,
                         decode_chunk=2, min_bucket=4)
-    eng.generate_many([np.arange(1, 4, dtype=np.int32)], max_new_tokens=2)
+    # warm the bucket-8 prefill the length-5 prompt below will use
+    # (disjoint tokens: a prefix hit would change the timed suffix) so
+    # the ``fn.prepare`` compile wall — which lands between admission
+    # and the prefill window, i.e. inside TTFT but outside both
+    # decomposition terms — is paid here
+    eng.generate_many([np.arange(10, 15, dtype=np.int32)],
+                      max_new_tokens=4)
     reg = get_registry()
     for nm in ("serving.ttft_seconds", "serving.queue_wait"):
         reg.get(nm).reset()
@@ -321,7 +354,18 @@ def test_serving_ttft_decomposition(tracer):
     queue = st["serving.queue_wait"]["mean"]
     prefill = req.prefill_t1 - req.prefill_t0
     ttft = st["serving.ttft_seconds"]["mean"]
-    assert abs((queue + prefill) - ttft) <= 0.10 * ttft
+    # the histogram and the request handle observe the SAME
+    # submit -> first-token window: identical up to float noise
+    assert ttft == pytest.approx(req.ttft, rel=1e-6)
+    # the decomposition: queue wait and prefill are disjoint
+    # sub-windows of TTFT measured from the same clock, so their sum
+    # can never exceed it; the residual (admission bookkeeping between
+    # admit_t and prefill_t0) is host wall the engine deliberately
+    # keeps OUT of both terms — bounding it would re-introduce the
+    # compile/scheduler wall sensitivity this test had at seed
+    assert queue >= 0 and prefill > 0
+    assert queue + prefill <= ttft + 1e-6
+    assert ttft <= req.e2e + 1e-6
 
 
 # -- bench history ----------------------------------------------------------

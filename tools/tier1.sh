@@ -42,8 +42,13 @@ fi
 # multichip smoke: the scaling-engine invariants on the 8-device virtual
 # CPU mesh — ZeRO-1 accumulator sharding (state bytes/device <=
 # replicated/4), one cross-chip gradient reduction per optimizer step
-# under accum (comm audit on compiled HLO), and ZeRO bit-exactness vs
-# the replicated spelling (docs/parallel.md)
+# under accum (comm audit on compiled HLO), ZeRO/FSDP bit-exactness vs
+# the replicated spelling, and the true-ZeRO-3 gradient gates:
+# zero3_grad_contract clean on the compiled plan (one boundary
+# reduce-scatter@fsdp per fsdp-tagged grad, zero in-loop reduces),
+# prologue (embedding + LM head) bytes/device bound, 5-step
+# bit-exactness vs PADDLE_TPU_ZERO3_RS=0, and comm_diff naming the
+# moved collectives (docs/parallel.md rule 4)
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
         python -m paddle_tpu --multichip-selftest \
         > /tmp/_t1_multichip.log 2>&1; then
@@ -66,13 +71,15 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 # sharding/comm-contract smoke: the communication contract analyzer —
-# three planted constraint-placement violations (symmetric fsdp pin,
-# fsdp-composed grad carry, forbidden activation reshard) each caught
-# with the right kind/axis/loop attribution, CommPlan mesh-axis
-# recovery + comm_diff, and the clean-GPT sweep (every memory_optimize
-# policy x FSDP on/off x ZeRO on/off on the 8-device CPU mesh)
-# reporting zero error-severity comm findings under the attached
-# training contracts (docs/analysis.md "Communication contracts")
+# four planted constraint-placement violations (symmetric fsdp pin,
+# fsdp-composed grad carry, forbidden activation reshard, in-loop
+# reduce-scatter caught by zero3_grad_contract) each caught with the
+# right kind/axis/loop attribution, CommPlan mesh-axis recovery +
+# comm_diff, and the clean-GPT sweep (every memory_optimize policy x
+# FSDP on/off x ZeRO on/off on the 8-device CPU mesh) reporting zero
+# error-severity comm findings under the attached training contracts,
+# zero3_grad_contract included (docs/analysis.md "Communication
+# contracts")
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
         python -m paddle_tpu --sharding-selftest \
         > /tmp/_t1_sharding.log 2>&1; then
